@@ -1,0 +1,60 @@
+"""Benchmark: cell-updates/sec/chip on the dense Moore-8 flow step.
+
+Measures the framework's headline metric (BASELINE.json: cell-updates/sec/
+chip on RectangularModel; north star >=1e9 on a 1e8-cell grid) on the real
+TPU chip. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline is value / 1e9 (the north-star target — the reference itself
+publishes no numbers, SURVEY §6).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def bench(grid: int = 8192, steps_per_call: int = 20, reps: int = 5,
+          dtype_name: str = "bfloat16", verbose: bool = False) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_model_tpu import CellularSpace, Diffusion, Model
+
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
+    space = CellularSpace.create(grid, grid, 1.0, dtype=dtype)
+    model = Model(Diffusion(0.1), 1.0, 1.0)
+    step = model.make_step(space)
+
+    @jax.jit
+    def run(v):
+        def body(c, _):
+            return step(c), None
+        out, _ = jax.lax.scan(body, v, None, length=steps_per_call)
+        return out
+
+    values = dict(space.values)
+    # warmup / compile
+    out = jax.block_until_ready(run(values))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(run(values))
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+        if verbose:
+            print(f"  {steps_per_call} steps in {dt:.4f}s", file=sys.stderr)
+    cups = grid * grid * steps_per_call / best
+    return {
+        "metric": f"cell-updates/sec/chip (dense Moore-8 flow step, "
+                  f"{grid}x{grid} {dtype_name})",
+        "value": cups,
+        "unit": "cell-updates/s",
+        "vs_baseline": cups / 1e9,
+    }
+
+
+if __name__ == "__main__":
+    result = bench(verbose="-v" in sys.argv)
+    print(json.dumps(result))

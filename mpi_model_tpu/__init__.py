@@ -1,0 +1,44 @@
+"""mpi_model_tpu — a TPU-native cellular-space simulation framework.
+
+Brand-new framework with the capabilities of daviidsilvaa/MPI-Model (a
+TerraME-style MPI cellular simulator; see SURVEY.md): CellularSpace / Cell /
+Attribute / Flow / Model, re-designed TPU-first — the grid is a sharded
+``jax.Array`` on a device mesh, flow kernels are fused stencil ops (Pallas
+for the large configs), and the halo exchange is ``shard_map`` + ``ppermute``
+over ICI behind a backend-agnostic abstraction seam.
+
+Layer map (mirrors SURVEY.md §1):
+  L0 ``abstraction``     — backend-neutral dtype seam (Abstraction.hpp)
+  L1 ``parallel``        — mesh/halo/collectives (MPIImpl + wire protocol)
+  L2 ``core``            — Attribute/Cell/CellularSpace (data model)
+  L3 ``ops``             — Flow/Exponencial + stencil/Pallas kernels
+  L4 ``models``          — Model/ModelRectangular (orchestration)
+  L5 ``native/`` + CLI   — C++ runtime & driver (Main.cpp)
+  —  ``utils``, ``io``   — config, metrics, checkpoint, output (aux)
+"""
+
+from .abstraction import DataType, get_abstraction_data_type
+from .core import Attribute, Cell, CellularSpace, Partition
+from .ops import Coupled, Diffusion, Exponencial, Flow, PointFlow
+from .models import ConservationError, Model, ModelRectangular, Report
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DataType",
+    "get_abstraction_data_type",
+    "Attribute",
+    "Cell",
+    "CellularSpace",
+    "Partition",
+    "Flow",
+    "Exponencial",
+    "PointFlow",
+    "Diffusion",
+    "Coupled",
+    "Model",
+    "ModelRectangular",
+    "Report",
+    "ConservationError",
+    "__version__",
+]

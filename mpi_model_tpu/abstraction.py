@@ -1,0 +1,149 @@
+"""L0 type abstraction: the backend-agnostic datatype seam.
+
+TPU-native rebuild of the reference's ``Abstraction.hpp`` (see
+``/root/reference/src/Abstraction.hpp:8-76``): a backend-neutral ``DataType``
+enum plus per-backend conversion functions. In the reference this is the one
+place where C++ scalar types meet the enum, and ``MPIImpl.hpp:11-25``
+(``ConvertType``) is the only place the enum meets ``MPI_Datatype``. Here the
+enum meets three backends instead:
+
+- ``to_jax``   — jnp dtypes (the TPU compute path),
+- ``to_numpy`` — the serial oracle,
+- ``to_native``— the C tag used by the native C++ runtime's ABI
+  (must stay in sync with ``native/include/mmtpu/abstraction.hpp``).
+
+Unsupported types raise, matching the reference's throw at
+``Abstraction.hpp:24-26``.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any
+
+import numpy as np
+
+
+class DataType(enum.IntEnum):
+    """Backend-neutral scalar datatype tags.
+
+    The integer values form the native ABI contract with the C++ runtime
+    (``mmtpu_dtype_t``) — do not reorder.
+    """
+
+    INT8 = 0
+    UINT8 = 1
+    INT16 = 2
+    UINT16 = 3
+    INT32 = 4
+    UINT32 = 5
+    INT64 = 6
+    UINT64 = 7
+    FLOAT32 = 8
+    FLOAT64 = 9
+    # TPU-era additions (no reference analogue; the reference predates ML dtypes)
+    BFLOAT16 = 10
+    FLOAT16 = 11
+    BOOL = 12
+
+
+class UnsupportedDataTypeError(TypeError):
+    """Raised for types outside the supported set (Abstraction.hpp:24-26)."""
+
+
+_CANONICAL: dict[str, DataType] = {
+    "int8": DataType.INT8,
+    "uint8": DataType.UINT8,
+    "int16": DataType.INT16,
+    "uint16": DataType.UINT16,
+    "int32": DataType.INT32,
+    "uint32": DataType.UINT32,
+    "int64": DataType.INT64,
+    "uint64": DataType.UINT64,
+    "float32": DataType.FLOAT32,
+    "float64": DataType.FLOAT64,
+    "bfloat16": DataType.BFLOAT16,
+    "float16": DataType.FLOAT16,
+    "bool": DataType.BOOL,
+}
+
+_PY_SCALARS: dict[type, DataType] = {
+    int: DataType.INT64,
+    float: DataType.FLOAT64,
+    bool: DataType.BOOL,
+}
+
+
+def get_abstraction_data_type(tp: Any) -> DataType:
+    """Map a dtype-like (numpy/jax dtype, str, python scalar type) to DataType.
+
+    Equivalent of the ten ``getAbstractionDataType<T>()`` specializations at
+    ``Abstraction.hpp:23-76``, widened with the TPU dtypes.
+    """
+    if isinstance(tp, DataType):
+        return tp
+    if isinstance(tp, type) and tp in _PY_SCALARS:
+        return _PY_SCALARS[tp]
+    try:
+        name = np.dtype(tp).name
+    except TypeError as exc:
+        # np.dtype chokes on jax's bfloat16 scalar type only on old numpys;
+        # fall back to the type's name attribute.
+        name = getattr(tp, "name", None) or getattr(tp, "__name__", None)
+        if name is None:
+            raise UnsupportedDataTypeError(f"unsupported data type: {tp!r}") from exc
+    dt = _CANONICAL.get(str(name))
+    if dt is None:
+        raise UnsupportedDataTypeError(f"unsupported data type: {tp!r}")
+    return dt
+
+
+def to_numpy(dt: DataType) -> np.dtype:
+    """DataType → numpy dtype (the oracle backend's ConvertType)."""
+    if dt == DataType.BFLOAT16:
+        # numpy has no native bfloat16; ml_dtypes ships with jax.
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(DataType(dt).name.lower())
+
+
+def to_jax(dt: DataType):
+    """DataType → jnp dtype (the TPU backend's ConvertType).
+
+    Mirrors ``MPIImpl.hpp:11-25``: enum in, backend type out, raise on
+    fall-through.
+    """
+    import jax.numpy as jnp
+
+    table = {
+        DataType.INT8: jnp.int8,
+        DataType.UINT8: jnp.uint8,
+        DataType.INT16: jnp.int16,
+        DataType.UINT16: jnp.uint16,
+        DataType.INT32: jnp.int32,
+        DataType.UINT32: jnp.uint32,
+        DataType.INT64: jnp.int64,
+        DataType.UINT64: jnp.uint64,
+        DataType.FLOAT32: jnp.float32,
+        DataType.FLOAT64: jnp.float64,
+        DataType.BFLOAT16: jnp.bfloat16,
+        DataType.FLOAT16: jnp.float16,
+        DataType.BOOL: jnp.bool_,
+    }
+    out = table.get(DataType(dt))
+    if out is None:  # pragma: no cover - enum is closed
+        raise UnsupportedDataTypeError(f"no jax conversion for {dt!r}")
+    return out
+
+
+def to_native(dt: DataType) -> int:
+    """DataType → native ABI tag (mmtpu_dtype_t in the C++ runtime)."""
+    return int(DataType(dt))
+
+
+def itemsize(dt: DataType) -> int:
+    """Size in bytes of one scalar of this DataType."""
+    if dt == DataType.BFLOAT16:
+        return 2
+    return to_numpy(dt).itemsize

@@ -1,0 +1,20 @@
+from .attribute import Attribute
+from .cell import (
+    MOORE_OFFSETS,
+    VON_NEUMANN_OFFSETS,
+    Cell,
+    moore_neighbors,
+    neighbor_count_grid,
+)
+from .cellular_space import CellularSpace, Partition
+
+__all__ = [
+    "Attribute",
+    "Cell",
+    "CellularSpace",
+    "Partition",
+    "MOORE_OFFSETS",
+    "VON_NEUMANN_OFFSETS",
+    "moore_neighbors",
+    "neighbor_count_grid",
+]

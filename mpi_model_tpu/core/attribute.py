@@ -1,0 +1,41 @@
+"""Attribute: a named cell payload.
+
+Rebuild of the reference's ``Attribute<T>{int key; T value}``
+(``/root/reference/src/Attribute.hpp:5-46``). In the TPU-native design an
+attribute is a *named channel of the whole grid* (struct-of-arrays), not a
+per-cell struct: ``CellularSpace`` stores one ``[H, W]`` array per attribute.
+This class is the scalar view used at the API boundary (constructing flows,
+reading single cells) — it never appears inside compiled code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Union
+
+from ..abstraction import DataType, get_abstraction_data_type
+
+
+@dataclasses.dataclass(frozen=True)
+class Attribute:
+    """A (key, value) cell payload.
+
+    ``key`` keeps the reference's int key field but is also usable as a
+    string name — the framework addresses attribute channels by name.
+    """
+
+    key: Union[int, str]
+    value: float
+
+    @property
+    def name(self) -> str:
+        return self.key if isinstance(self.key, str) else f"attr{self.key}"
+
+    def get_key(self) -> Union[int, str]:
+        return self.key
+
+    def get_value(self) -> float:
+        return self.value
+
+    def data_type(self) -> DataType:
+        return get_abstraction_data_type(type(self.value))

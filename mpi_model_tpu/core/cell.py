@@ -1,0 +1,126 @@
+"""Cell views and neighborhood topology.
+
+Rebuild of ``Cell<T>`` and its ``SetNeighbor()`` Moore-neighborhood builder
+(``/root/reference/src/Cell.hpp:9-158``). The reference stores, per cell, an
+explicit struct-of-arrays neighbor list (x's in slots [0..7], y's in [8..15])
+computed with 9 explicit boundary cases (4 corners → 3 neighbors, 4 edges → 5,
+interior → 8) against the *global* grid bounds.
+
+TPU-native design decision: neighbor topology is **implicit in the stencil**.
+Compiled kernels never materialize neighbor lists — boundary handling is
+zero-padded shifts plus a precomputed ``neighbor_count_grid`` (the vectorized
+equivalent of the 9 cases). ``Cell`` and ``moore_neighbors`` remain as the
+host-side scalar API for parity with the reference (constructing flows,
+inspecting cells, tests), and fix the reference's copy bug that drops the
+y-halves of neighbor slots (``Cell.hpp:33-35,45-47``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .attribute import Attribute
+
+#: Moore-8 neighborhood offsets (dx, dy), row-major order.
+MOORE_OFFSETS: tuple[tuple[int, int], ...] = (
+    (-1, -1), (-1, 0), (-1, 1),
+    (0, -1),           (0, 1),
+    (1, -1), (1, 0), (1, 1),
+)
+
+#: Von Neumann (4-neighbor) offsets — used by the 4-neighbor halo configs.
+VON_NEUMANN_OFFSETS: tuple[tuple[int, int], ...] = (
+    (-1, 0), (0, -1), (0, 1), (1, 0),
+)
+
+
+def moore_neighbors(
+    x: int, y: int, dim_x: int, dim_y: int,
+    offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS,
+) -> list[tuple[int, int]]:
+    """Neighbors of global cell (x, y) on a non-periodic dim_x × dim_y grid.
+
+    One expression replaces the reference's 9 explicit boundary cases
+    (``Cell.hpp:71-157``): corners get 3, edges 5, interior 8 (Moore).
+    """
+    return [
+        (x + dx, y + dy)
+        for dx, dy in offsets
+        if 0 <= x + dx < dim_x and 0 <= y + dy < dim_y
+    ]
+
+
+def neighbor_count_grid(
+    dim_x: int,
+    dim_y: int,
+    offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS,
+    dtype=np.float64,
+    x_init: int = 0,
+    y_init: int = 0,
+    global_dim_x: Optional[int] = None,
+    global_dim_y: Optional[int] = None,
+) -> np.ndarray:
+    """[dim_x, dim_y] array of per-cell neighbor counts.
+
+    Vectorized form of running ``SetNeighbor()`` on every cell: interior 8,
+    edges 5, corners 3 for Moore (4/3/2 for von Neumann). Used as the
+    divisor of the mass-conserving flow redistribution.
+
+    For a *partition* of a larger grid, pass the partition origin
+    (``x_init``, ``y_init``) and the global dims: counts are then evaluated
+    against the **global** bounds, exactly as the reference's ``SetNeighbor``
+    does for worker partitions (``Cell.hpp:71-157`` uses DIMX/DIMY, not the
+    partition extent).
+    """
+    gdx = dim_x if global_dim_x is None else global_dim_x
+    gdy = dim_y if global_dim_y is None else global_dim_y
+    counts = np.zeros((dim_x, dim_y), dtype=dtype)
+    xs = x_init + np.arange(dim_x)
+    ys = y_init + np.arange(dim_y)
+    for dx, dy in offsets:
+        # A neighbor in direction (dx,dy) exists wherever the shifted global
+        # index stays inside the global bounds.
+        x_ok = (xs + dx >= 0) & (xs + dx < gdx)
+        y_ok = (ys + dy >= 0) & (ys + dy < gdy)
+        counts += np.outer(x_ok, y_ok).astype(dtype)
+    return counts
+
+
+@dataclasses.dataclass
+class Cell:
+    """Host-side scalar view of one cell (reference ``Cell.hpp:9-158``).
+
+    ``x`` indexes rows, ``y`` columns, matching the reference's layout
+    (row-major ``memoria[x*width + y]``).
+    """
+
+    x: int
+    y: int
+    attribute: Attribute
+    neighbors: list[tuple[int, int]] = dataclasses.field(default_factory=list)
+
+    @property
+    def count_neighbors(self) -> int:
+        return len(self.neighbors)
+
+    def set_neighbor(self, dim_x: int, dim_y: int,
+                     offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS) -> "Cell":
+        """Compute this cell's neighborhood against the global bounds.
+
+        Reference: ``Cell::SetNeighbor()`` (``Cell.hpp:71-157``). Returns self
+        (the reference reassigns the result) with the full neighbor list —
+        both coordinates preserved, unlike the reference's copy-ctor bug.
+        """
+        self.neighbors = moore_neighbors(self.x, self.y, dim_x, dim_y, offsets)
+        return self
+
+    def neighbor_xs(self) -> list[int]:
+        """x-halves of the neighbor list (reference slots [0..NEIGHBORS))."""
+        return [nx for nx, _ in self.neighbors]
+
+    def neighbor_ys(self) -> list[int]:
+        """y-halves (reference slots [NEIGHBORS..2*NEIGHBORS))."""
+        return [ny for _, ny in self.neighbors]

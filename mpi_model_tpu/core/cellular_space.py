@@ -1,0 +1,271 @@
+"""CellularSpace: the grid state as a struct-of-arrays pytree.
+
+Rebuild of ``CellularSpace<T>`` / ``CellularSpaceRectangular<T>``
+(``/root/reference/src/CellularSpace.hpp:11-80``,
+``CellularSpaceRectangular.hpp:9-32``). The reference stores an
+array-of-structs ``Cell memoria[PROC_DIMX*PROC_DIMY]`` sized for one
+partition, with per-cell neighbor lists. TPU-native design:
+
+- the whole grid is a dict of named attribute channels, each one
+  ``[dim_x, dim_y]`` ``jax.Array`` (struct-of-arrays — MXU/VPU friendly,
+  shardable with ``NamedSharding``);
+- neighbor topology is implicit (see ``core.cell``);
+- partitioning is *sharding metadata*, not a different class: the same
+  ``CellularSpace`` value can be replicated, 1-D row-striped (the reference's
+  ``Model`` decomposition, ``Defines.hpp:8``) or 2-D block-decomposed (the
+  ``ModelRectangular`` one) purely by the sharding attached to its arrays.
+
+``Partition`` reifies the reference's wire-protocol partition descriptor
+``"x_init|y_init:height|width"`` (``Model.hpp:67-76``) as a typed value — the
+intent of the dead ``CellularSpace::Scatter`` (``CellularSpace.hpp:36-79``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..abstraction import DataType, get_abstraction_data_type, to_jax
+from .attribute import Attribute
+from .cell import MOORE_OFFSETS, Cell, neighbor_count_grid
+
+#: Default attribute channel name (the reference's live flow targets key 99,
+#: ``Main.cpp:33``; cells are seeded with value 1, ``Model.hpp:155``).
+DEFAULT_ATTR = "value"
+
+
+@dataclasses.dataclass(frozen=True)
+class Partition:
+    """One shard of the global grid: origin + extent (+ owner rank).
+
+    Typed replacement for the sprintf-serialized descriptor the reference
+    masters send to workers (``Model.hpp:67-76`` / parse at ``:138-146``).
+    """
+
+    x_init: int
+    y_init: int
+    height: int
+    width: int
+    rank: int = 0
+
+    def contains(self, x: int, y: int) -> bool:
+        return (self.x_init <= x < self.x_init + self.height
+                and self.y_init <= y < self.y_init + self.width)
+
+    def local(self, x: int, y: int) -> tuple[int, int]:
+        """Global → local coordinates (fixes the reference's mixed
+        global/local indexing bug, ``Model.hpp:177`` / TODO at ``:169-173``)."""
+        return x - self.x_init, y - self.y_init
+
+    def describe(self) -> str:
+        """The reference's wire format, for logs/tests."""
+        return f"{self.x_init}|{self.y_init}:{self.height}|{self.width}"
+
+    @staticmethod
+    def parse(s: str) -> "Partition":
+        xy, hw = s.split(":")
+        x, y = xy.split("|")
+        h, w = hw.split("|")
+        return Partition(int(x), int(y), int(h), int(w))
+
+
+def row_partitions(dim_x: int, dim_y: int, n: int) -> list[Partition]:
+    """1-D row-striped decomposition (``Model.hpp:62-76``, PROC_DIMX=DIMX/N).
+
+    Unlike the reference (which requires exact divisibility at compile time),
+    trailing remainder rows go to the last partition.
+    """
+    base = dim_x // n
+    parts = []
+    for r in range(n):
+        h = base if r < n - 1 else dim_x - base * (n - 1)
+        parts.append(Partition(r * base, 0, h, dim_y, rank=r))
+    return parts
+
+
+def block_partitions(dim_x: int, dim_y: int, lines: int, columns: int) -> list[Partition]:
+    """2-D block decomposition (``ModelRectangular.hpp:69-80``,
+    LINES_REC × COLUMNS_REC process grid), remainder-safe, row-major ranks."""
+    bx, by = dim_x // lines, dim_y // columns
+    parts = []
+    for i in range(lines):
+        h = bx if i < lines - 1 else dim_x - bx * (lines - 1)
+        for j in range(columns):
+            w = by if j < columns - 1 else dim_y - by * (columns - 1)
+            parts.append(Partition(i * bx, j * by, h, w, rank=i * columns + j))
+    return parts
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class CellularSpace:
+    """The grid: named attribute channels over a dim_x × dim_y cell space.
+
+    A pytree — flows through ``jit``/``shard_map``/``scan`` directly. The
+    metadata fields (origin, dims) are static.
+
+    ``dim_x``/``dim_y`` are always the **local array extent** (the shape of
+    every channel). A space is either the whole grid (``x_init = y_init = 0``
+    and global dims unset) or a partition of one: then (``x_init``,
+    ``y_init``) is its global origin and ``global_dim_x``/``global_dim_y``
+    the full-grid bounds, against which boundary topology (neighbor counts)
+    is evaluated — mirroring how the reference's workers build partition
+    cells but call ``SetNeighbor`` against DIMX/DIMY (``Model.hpp:154-157``).
+    """
+
+    values: dict[str, jax.Array]
+    dim_x: int = dataclasses.field(metadata=dict(static=True))
+    dim_y: int = dataclasses.field(metadata=dict(static=True))
+    x_init: int = dataclasses.field(default=0, metadata=dict(static=True))
+    y_init: int = dataclasses.field(default=0, metadata=dict(static=True))
+    global_dim_x: Optional[int] = dataclasses.field(
+        default=None, metadata=dict(static=True))
+    global_dim_y: Optional[int] = dataclasses.field(
+        default=None, metadata=dict(static=True))
+
+    # -- construction ------------------------------------------------------
+
+    @staticmethod
+    def create(
+        dim_x: int,
+        dim_y: int,
+        attributes: Union[None, float, Mapping[str, float]] = None,
+        dtype: Any = jnp.float32,
+        x_init: int = 0,
+        y_init: int = 0,
+        global_dim_x: Optional[int] = None,
+        global_dim_y: Optional[int] = None,
+    ) -> "CellularSpace":
+        """Build a dim_x × dim_y grid (or partition, when an origin/global
+        dims are given) with every cell of every channel set to its init
+        value (reference seeds 1, ``Model.hpp:155``)."""
+        jdt = to_jax(get_abstraction_data_type(dtype))
+        if attributes is None:
+            attributes = {DEFAULT_ATTR: 1.0}
+        elif isinstance(attributes, (int, float)):
+            attributes = {DEFAULT_ATTR: float(attributes)}
+        vals = {
+            name: jnp.full((dim_x, dim_y), init, dtype=jdt)
+            for name, init in attributes.items()
+        }
+        return CellularSpace(vals, dim_x, dim_y, x_init, y_init,
+                             global_dim_x, global_dim_y)
+
+    # -- shape / dtype -----------------------------------------------------
+
+    @property
+    def height(self) -> int:
+        return self.dim_x
+
+    @property
+    def width(self) -> int:
+        return self.dim_y
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return (self.dim_x, self.dim_y)
+
+    @property
+    def global_shape(self) -> tuple[int, int]:
+        """Full-grid bounds this (possibly partition) space lives in."""
+        return (self.global_dim_x or self.dim_x, self.global_dim_y or self.dim_y)
+
+    @property
+    def is_partition(self) -> bool:
+        return self.global_shape != self.shape or (self.x_init, self.y_init) != (0, 0)
+
+    @property
+    def attribute_names(self) -> tuple[str, ...]:
+        return tuple(self.values.keys())
+
+    @property
+    def dtype(self):
+        return next(iter(self.values.values())).dtype
+
+    def data_type(self) -> DataType:
+        return get_abstraction_data_type(self.dtype)
+
+    # -- cell access (host-side API; not for compiled inner loops) ---------
+
+    def _local_index(self, x: int, y: int) -> tuple[int, int]:
+        """Global → local index with bounds check (no silent negative-index
+        wrapping — the reference's mixed global/local indexing bug class,
+        ``Model.hpp:169-177``)."""
+        lx, ly = x - self.x_init, y - self.y_init
+        if not (0 <= lx < self.dim_x and 0 <= ly < self.dim_y):
+            raise IndexError(
+                f"global cell ({x}, {y}) is outside this partition "
+                f"[{self.x_init}:{self.x_init + self.dim_x}, "
+                f"{self.y_init}:{self.y_init + self.dim_y})")
+        return lx, ly
+
+    def get_cell(self, x: int, y: int, attr: str = DEFAULT_ATTR) -> Cell:
+        lx, ly = self._local_index(x, y)
+        v = float(self.values[attr][lx, ly])
+        c = Cell(x, y, Attribute(attr, v))
+        return c.set_neighbor(*self.global_shape)
+
+    def set_cell(self, x: int, y: int, value: float,
+                 attr: str = DEFAULT_ATTR) -> "CellularSpace":
+        """Functional single-cell update (replaces the dead SetCell,
+        ``CellularSpace.hpp:84-179``)."""
+        lx, ly = self._local_index(x, y)
+        new = dict(self.values)
+        new[attr] = new[attr].at[lx, ly].set(value)
+        return dataclasses.replace(self, values=new)
+
+    # -- whole-grid ops ----------------------------------------------------
+
+    def total(self, attr: Optional[str] = None) -> jax.Array:
+        """Sum of one channel (or all channels): the conservation quantity
+        the reference reduces rank-by-rank (``Model.hpp:88-95,238-243``)."""
+        if attr is not None:
+            v = self.values[attr]
+            if jnp.issubdtype(v.dtype, jnp.integer):
+                # host-side int64 accumulation: a device int64 sum silently
+                # degrades to int32 when jax_enable_x64 is off
+                return np.asarray(v).sum(dtype=np.int64)
+            acc = jnp.float64 if v.dtype == jnp.float64 else jnp.float32
+            return jnp.sum(v, dtype=acc)
+        return sum(self.total(a) for a in self.values)
+
+    def neighbor_counts(self, offsets=MOORE_OFFSETS) -> jax.Array:
+        """Per-cell neighbor-count grid as a device array (stencil divisor).
+
+        For a partition space, counts are evaluated against the *global*
+        bounds, so interior partition edges read 8 while true grid edges
+        read 5/3."""
+        gdx, gdy = self.global_shape
+        return jnp.asarray(
+            neighbor_count_grid(
+                self.dim_x, self.dim_y, offsets,
+                x_init=self.x_init, y_init=self.y_init,
+                global_dim_x=gdx, global_dim_y=gdy),
+            dtype=self.dtype,
+        )
+
+    def with_values(self, values: Mapping[str, jax.Array]) -> "CellularSpace":
+        return dataclasses.replace(self, values=dict(values))
+
+    # -- partitioning ------------------------------------------------------
+
+    def slice_partition(self, p: Partition) -> "CellularSpace":
+        """Materialize one partition as its own (host-addressable) space —
+        the typed equivalent of the dead ``Scatter`` worker branch
+        (``CellularSpace.hpp:61-78``). Sharded execution does NOT use this;
+        it shards the global arrays in place."""
+        lx, ly = p.x_init - self.x_init, p.y_init - self.y_init
+        vals = {
+            k: jax.lax.slice(v, (lx, ly), (lx + p.height, ly + p.width))
+            for k, v in self.values.items()
+        }
+        gdx, gdy = self.global_shape
+        return CellularSpace(vals, p.height, p.width, p.x_init, p.y_init,
+                             gdx, gdy)
+
+    def to_numpy(self) -> dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self.values.items()}

@@ -1,0 +1,10 @@
+from .model import ConservationError, Model, Report, SerialExecutor
+from .model_rectangular import ModelRectangular
+
+__all__ = [
+    "Model",
+    "ModelRectangular",
+    "Report",
+    "ConservationError",
+    "SerialExecutor",
+]

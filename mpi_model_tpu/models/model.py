@@ -1,0 +1,278 @@
+"""Model: the simulation orchestrator (time loop + conservation contract).
+
+Rebuild of ``Model<T>`` (``/root/reference/src/Model.hpp:14-263``). The
+reference's ``execute<R>(comm, cs)`` inlines decomposition, a string control
+protocol, the flow step, a halo exchange, a hand-rolled reduction and file
+merge. Here those concerns are factored:
+
+- the **step** is a pure function (``ops``), compiled once;
+- the **time loop** is ``lax.scan`` inside one ``jit`` — the reference's
+  loop is written but disabled (``Model.hpp:180-183``), so it always runs
+  exactly one step; we implement the intended ``time / time_step`` schedule
+  (pass ``steps=1`` for reference-exact behavior);
+- **decomposition/halo** live in the pluggable ``Executor`` (serial here,
+  sharded in ``parallel.executors``);
+- the **conservation contract** (``Model.hpp:88-95``: global attribute sum
+  preserved to 1e-3) is checked with a proper ``abs`` — the reference's
+  assert lacks ``fabs`` (SURVEY §2 defects) — against the *measured* initial
+  total instead of a hardcoded 10000;
+- the per-rank reduction becomes ``jnp.sum`` on the (possibly sharded)
+  array — XLA lowers it to an ICI all-reduce, replacing the hand-rolled
+  send/recv loops (``Model.hpp:88-92,238-243``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time as _time
+from typing import Callable, Optional, Protocol, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.cell import MOORE_OFFSETS
+from ..core.cellular_space import CellularSpace
+from ..ops.flow import Flow, PointFlow, build_outflow
+from ..ops.stencil import point_flow_step, transport
+
+Values = dict[str, jax.Array]
+
+
+class ConservationError(AssertionError):
+    """Mass-conservation contract violated (``Model.hpp:95``, with fabs)."""
+
+
+@dataclasses.dataclass
+class Report:
+    """Run report — the live realization of the reference's vestigial
+    ``MPI_Report{comm_size, rank_id}`` (``MPI_Report.hpp:5-20``, never used
+    there), extended with what a run actually needs to report."""
+
+    comm_size: int
+    rank_id: int
+    steps: int
+    initial_total: dict[str, float]
+    final_total: dict[str, float]
+    #: per-flow amounts evaluated on the FINAL state (what the next step
+    #: would move), aligned with Model.flows. For frozen-snapshot flows —
+    #: the reference's live case — this equals the amount of the last
+    #: executed step, i.e. the ``Flow::last_execute`` memo (``Flow.hpp:14``);
+    #: for dynamic flows it trails it by one step.
+    last_execute: list[float]
+    wall_time_s: float
+
+    def conservation_error(self) -> float:
+        return max(
+            abs(self.final_total[k] - self.initial_total[k])
+            for k in self.initial_total
+        )
+
+
+class Executor(Protocol):
+    """Execution strategy: how the compiled step runs over devices."""
+
+    def run_model(self, model: "Model", space: CellularSpace,
+                  num_steps: int) -> Values: ...
+
+    @property
+    def comm_size(self) -> int: ...
+
+
+class SerialExecutor:
+    """Single-device execution: ``jit(scan(step))`` (the reference's serial
+    ``execute()`` stub, ``Model.hpp:47-51``, 'missing implement' — here
+    implemented). The jitted runner is cached per (step, num_steps) so
+    repeated ``execute`` calls don't retrace."""
+
+    comm_size = 1
+
+    def __init__(self):
+        self._cache: dict = {}
+
+    def run_model(self, model: "Model", space: CellularSpace,
+                  num_steps: int) -> Values:
+        step = model.make_step(space)
+        key = (step, num_steps)
+        runner = self._cache.get(key)
+        if runner is None:
+            def _run(v):
+                def body(c, _):
+                    return step(c), None
+                out, _ = jax.lax.scan(body, v, None, length=num_steps)
+                return out
+            runner = jax.jit(_run)
+            self._cache[key] = runner
+        return runner(dict(space.values))
+
+
+class Model:
+    """Orchestrates flows over a CellularSpace for ``time/time_step`` steps.
+
+    Signature parity: the reference constructs
+    ``Model<Exponencial<double>>(flow, final_time, time_step)``
+    (``Main.cpp:32-33``, ``Model.hpp:23-27``).
+    """
+
+    #: neighborhood used by transport (ModelRectangular overrides docs-wise)
+    offsets: tuple[tuple[int, int], ...] = MOORE_OFFSETS
+
+    def __init__(self, flow: Union[Flow, Sequence[Flow]], time: float = 1.0,
+                 time_step: float = 1.0, *,
+                 offsets: Optional[Sequence[tuple[int, int]]] = None):
+        self.flows: list[Flow] = list(flow) if isinstance(flow, (list, tuple)) else [flow]
+        self.time = float(time)
+        self.time_step = float(time_step)
+        if offsets is not None:
+            self.offsets = tuple(offsets)
+        self._step_cache: dict = {}
+        self._default_executor: Optional[SerialExecutor] = None
+
+    @property
+    def flow(self) -> Flow:
+        """The reference's single-flow accessor."""
+        return self.flows[0]
+
+    @property
+    def num_steps(self) -> int:
+        return max(1, int(round(self.time / self.time_step)))
+
+    # -- step construction -------------------------------------------------
+
+    def make_step(self, space: CellularSpace) -> Callable[[Values], Values]:
+        """Build the pure per-step function for this space's geometry.
+
+        Point-source flows take the sparse scatter path
+        (``ops.stencil.point_flow_step`` — O(1) work instead of a dense
+        one-hot field over the grid); field flows take the dense transport.
+        All amounts are computed from the pre-step values, so the result is
+        identical to summing every flow's outflow field. Cached per
+        geometry so repeat executions reuse the same compiled step."""
+        if not jnp.issubdtype(space.dtype, jnp.floating):
+            raise TypeError(
+                f"flow transport requires a floating dtype, got {space.dtype}"
+                " (integer channels are supported for storage/comm, not flows)")
+        key = (space.shape, space.global_shape, (space.x_init, space.y_init),
+               str(space.dtype), self.offsets,
+               tuple(f.fingerprint() for f in self.flows))
+        cached = self._step_cache.get(key)
+        if cached is not None:
+            return cached
+
+        counts = space.neighbor_counts(self.offsets)
+        offsets = self.offsets
+        origin = (space.x_init, space.y_init)
+        point_flows = [f for f in self.flows if isinstance(f, PointFlow)]
+        field_flows = [f for f in self.flows if not isinstance(f, PointFlow)]
+        pt_by_attr: dict[str, list[PointFlow]] = {}
+        for f in point_flows:
+            # Sources outside this partition contribute nothing here (the
+            # reference's owner-rank test, Model.hpp:176).
+            if f.local_source({f.attr: next(iter(space.values.values()))},
+                              origin)[2]:
+                pt_by_attr.setdefault(f.attr, []).append(f)
+
+        def step(values: Values) -> Values:
+            new = dict(values)
+            outflow = build_outflow(field_flows, values, origin)
+            # Point amounts read the PRE-step values (matches summed-outflow
+            # semantics: transport is linear in outflow).
+            pt_updates = {}
+            for attr, pflows in pt_by_attr.items():
+                locs = [f.local_source(values, origin) for f in pflows]
+                xs = jnp.asarray([lx for lx, _, _ in locs])
+                ys = jnp.asarray([ly for _, ly, _ in locs])
+                amts = jnp.stack([f.amount(values, origin) for f in pflows])
+                pt_updates[attr] = (xs, ys, amts)
+            for attr, o in outflow.items():
+                new[attr] = transport(values[attr], o, counts, offsets)
+            for attr, (xs, ys, amts) in pt_updates.items():
+                new[attr] = point_flow_step(new[attr], xs, ys, amts, counts,
+                                            offsets)
+            return new
+
+        self._step_cache[key] = step
+        return step
+
+    # -- execution ---------------------------------------------------------
+
+    def conservation_threshold(self, space: CellularSpace,
+                               tolerance: float = 1e-3,
+                               rtol: Optional[float] = None,
+                               initial_totals: Optional[dict] = None) -> float:
+        """Allowed |Δtotal|: ``tolerance + rtol * |initial_total|``.
+
+        ``tolerance`` is the reference's absolute 1e-3 contract
+        (``Model.hpp:95``); the relative term absorbs the reduction's own
+        floating-point noise, which grows with grid size — without it a
+        *perfectly conserving* f32 run on a large grid trips the absolute
+        bound. Default rtol ≈ 4·eps·log2(N), the pairwise-summation error
+        bound for XLA reductions."""
+        if rtol is None:
+            n = max(space.dim_x * space.dim_y, 2)
+            eps = float(jnp.finfo(space.dtype).eps)
+            rtol = 4.0 * eps * math.log2(n)
+        if initial_totals is None:
+            initial_totals = {k: float(space.total(k)) for k in space.values}
+        scale = max(abs(t) for t in initial_totals.values())
+        return tolerance + rtol * scale
+
+    def execute(
+        self,
+        space: CellularSpace,
+        executor: Optional[Executor] = None,
+        *,
+        steps: Optional[int] = None,
+        check_conservation: bool = True,
+        tolerance: float = 1e-3,
+        rtol: Optional[float] = None,
+    ) -> tuple[CellularSpace, Report]:
+        """Run the model; returns the final space and a Report.
+
+        ``check_conservation`` enforces the reference's correctness contract
+        (global sum within tolerance of its initial value, ``Model.hpp:95``)
+        and raises ``ConservationError`` on violation; see
+        ``conservation_threshold`` for how the bound scales.
+
+        Executing a standalone *partition* space runs it like a reference
+        worker before any halo receive: shares crossing the partition's
+        interior edges are dropped (they belong to neighbor partitions), so
+        conservation is a global—not per-partition—property and the check is
+        skipped automatically. Use a sharded executor on the full space for
+        distributed runs with halo delivery.
+        """
+        if executor is None:
+            if self._default_executor is None:
+                self._default_executor = SerialExecutor()
+            executor = self._default_executor
+        num_steps = self.num_steps if steps is None else steps
+
+        initial = {k: float(space.total(k)) for k in space.values}
+        t0 = _time.perf_counter()
+        out_values = executor.run_model(self, space, num_steps)
+        out_values = jax.tree.map(jax.block_until_ready, out_values)
+        wall = _time.perf_counter() - t0
+
+        out_space = space.with_values(out_values)
+        final = {k: float(out_space.total(k)) for k in out_space.values}
+        last_exec = [float(f.execute(out_space)) for f in self.flows]
+
+        report = Report(
+            comm_size=getattr(executor, "comm_size", 1),
+            rank_id=0,
+            steps=num_steps,
+            initial_total=initial,
+            final_total=final,
+            last_execute=last_exec,
+            wall_time_s=wall,
+        )
+        if check_conservation and not space.is_partition:
+            thresh = self.conservation_threshold(space, tolerance, rtol,
+                                                 initial_totals=initial)
+            if report.conservation_error() > thresh:
+                raise ConservationError(
+                    f"mass conservation violated: |Δ| = "
+                    f"{report.conservation_error():.3e} > {thresh:.3e} "
+                    f"(initial={initial}, final={final})")
+        return out_space, report

@@ -1,0 +1,48 @@
+"""ModelRectangular: 2-D block-decomposed model.
+
+Rebuild of ``ModelRectangular<T>`` (``/root/reference/src/
+ModelRectangular.hpp:13-273``). The reference's 2-D variant walks a
+``LINES_REC × COLUMNS_REC`` process grid assigning ``PROC_DIMX_REC ×
+PROC_DIMY_REC`` blocks (``ModelRectangular.hpp:69-80``) but its receive-side
+halo, reduction and merge stages are commented out (``:94-129, 235-270``)
+and its owner formula is wrong (``:85``) — SURVEY §2 defects. Here the 2-D
+case is *finished*: the step semantics are identical to ``Model`` (the
+update is decomposition-agnostic); the 2-D-ness is the executor's mesh.
+``default_executor()`` builds a ``ShardMapExecutor`` over a 2-axis mesh
+(most-square factorization of the devices, or the lines/columns hints
+mirroring ``DefinesRectangular.hpp:7-8``), giving block decomposition with
+a full 8-neighbor (edge + corner) halo exchange over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .model import Model
+
+
+class ModelRectangular(Model):
+    """2-D block-decomposition model: ``Model`` whose default executor is
+    a ``ShardMapExecutor`` over a 2-D device mesh."""
+
+    def __init__(self, flow, time: float = 1.0, time_step: float = 1.0, *,
+                 lines: Optional[int] = None, columns: Optional[int] = None,
+                 offsets=None):
+        super().__init__(flow, time, time_step, offsets=offsets)
+        self.lines = lines
+        self.columns = columns
+
+    def default_executor(self, devices: Optional[Sequence] = None):
+        """ShardMapExecutor on a lines × columns mesh (2-D block halo)."""
+        from ..parallel.executors import ShardMapExecutor
+        from ..parallel.mesh import make_mesh_2d
+
+        mesh = make_mesh_2d(self.lines, self.columns, devices=devices)
+        return ShardMapExecutor(mesh)
+
+    def execute(self, space, executor=None, **kw):
+        if executor is None:
+            if self._default_executor is None:
+                self._default_executor = self.default_executor()
+            executor = self._default_executor
+        return super().execute(space, executor, **kw)

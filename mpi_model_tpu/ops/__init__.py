@@ -1,0 +1,15 @@
+from .flow import Coupled, Diffusion, Exponencial, Flow, PointFlow, build_outflow
+from .stencil import flow_step, point_flow_step, shift2d, transport
+
+__all__ = [
+    "Flow",
+    "Exponencial",
+    "PointFlow",
+    "Diffusion",
+    "Coupled",
+    "build_outflow",
+    "shift2d",
+    "transport",
+    "flow_step",
+    "point_flow_step",
+]

@@ -1,0 +1,197 @@
+"""Flow: update rules attached to the cellular space.
+
+Rebuild of the reference's op hierarchy — abstract ``Flow<T>``
+(``/root/reference/src/Flow.hpp:7-58``) and concrete ``Exponencial<T>``
+(``Exponencial.hpp:8-21``: ``execute() = flow_rate * source.attribute.value``).
+
+TPU-native design: a Flow is a declarative description that compiles to an
+**outflow field** — a ``[dim_x, dim_y]`` array of how much each cell sheds
+this step. All flows on one attribute sum their outflow fields and a single
+``transport`` performs the redistribution, so any number of flows is one
+fused XLA computation (the reference instead ships one command string per
+flow and branches per rank, ``Model.hpp:79-86,176``). Point-source flows
+also expose the sparse scatter path (``ops.stencil.point_flow_step``).
+
+The reference holds the flow's source cell **by value** (a snapshot:
+``Flow.hpp:22-28``), so its live run computes ``0.1 * 2.2`` from the
+constructor snapshot while the grid cell still holds 1.0. ``Exponencial``
+reproduces that with ``frozen_source_value``; the default (intended)
+semantics read the *current* grid value.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.cell import Cell
+from ..core.cellular_space import DEFAULT_ATTR, CellularSpace
+
+
+def _source_xy(source) -> tuple[int, int]:
+    if isinstance(source, Cell):
+        return source.x, source.y
+    x, y = source
+    return int(x), int(y)
+
+
+class Flow(abc.ABC):
+    """An update rule: produces the per-cell outflow of one attribute.
+
+    Subclasses implement ``outflow(values)`` where ``values`` maps attribute
+    name → ``[dim_x, dim_y]`` array, returning the outflow array for
+    ``self.attr``.
+    """
+
+    attr: str = DEFAULT_ATTR
+    flow_rate: float = 0.0
+
+    @abc.abstractmethod
+    def outflow(self, values: dict[str, jax.Array],
+                origin: tuple[int, int] = (0, 0)) -> jax.Array:
+        """Outflow field for ``self.attr``. ``origin`` is the global
+        coordinate of ``values[...][0, 0]`` — nonzero for partition spaces."""
+
+    def execute(self, space_or_values=None,
+                origin: tuple[int, int] = (0, 0)) -> jax.Array:
+        """Total amount moved this step (reference ``Flow::execute`` /
+        ``last_execute`` memo, ``Flow.hpp:14,57``)."""
+        if isinstance(space_or_values, CellularSpace):
+            origin = (space_or_values.x_init, space_or_values.y_init)
+            values = space_or_values.values
+        else:
+            values = space_or_values
+        return jnp.sum(self.outflow(values, origin))
+
+    def fingerprint(self) -> tuple:
+        """Hashable identity of this flow's parameters — step-cache key
+        component so mutating a flow invalidates compiled steps. Covers
+        dataclass fields and plain instance attributes alike (user-defined
+        Flow subclasses need not be dataclasses)."""
+        if dataclasses.is_dataclass(self):
+            attrs = {f.name: getattr(self, f.name)
+                     for f in dataclasses.fields(self)}
+        else:
+            attrs = vars(self)
+        items = tuple(
+            (k, v if isinstance(v, (int, float, str, bool, tuple, type(None)))
+             else repr(v))
+            for k, v in sorted(attrs.items()))
+        return (type(self).__name__, items)
+
+
+@dataclasses.dataclass
+class PointFlow(Flow):
+    """A flow anchored at one source cell; sheds to the source's neighbors.
+
+    ``source`` may be a ``Cell`` (reference style, ``Main.cpp:32-33``) or an
+    ``(x, y)`` pair. ``frozen_source_value`` reproduces the reference's
+    snapshot semantics (see module docstring).
+    """
+
+    source: Union[Cell, tuple[int, int]]
+    flow_rate: float
+    attr: str = DEFAULT_ATTR
+    frozen_source_value: Optional[float] = None
+
+    def __post_init__(self):
+        if (isinstance(self.source, Cell)
+                and self.frozen_source_value is None
+                and self.source.attribute is not None):
+            # Reference semantics: constructing from a Cell snapshots its
+            # attribute value (Flow.hpp:22-28).
+            self.frozen_source_value = self.source.attribute.value
+
+    @property
+    def source_xy(self) -> tuple[int, int]:
+        return _source_xy(self.source)
+
+    def local_source(self, values: dict[str, jax.Array],
+                     origin: tuple[int, int] = (0, 0)) -> tuple[int, int, bool]:
+        """(local_x, local_y, in_partition) for this source under origin."""
+        x, y = self.source_xy
+        lx, ly = x - origin[0], y - origin[1]
+        h, w = values[self.attr].shape[-2], values[self.attr].shape[-1]
+        return lx, ly, (0 <= lx < h and 0 <= ly < w)
+
+    def amount(self, values: dict[str, jax.Array],
+               origin: tuple[int, int] = (0, 0)) -> jax.Array:
+        """Amount shed this step: rate × (snapshot or current grid value).
+        Zero when the source lies outside this partition (the reference's
+        owner-rank test, ``Model.hpp:176``, as a value instead of a branch)."""
+        dtype = values[self.attr].dtype
+        lx, ly, inside = self.local_source(values, origin)
+        if not inside:
+            return jnp.zeros((), dtype=dtype)
+        v = (self.frozen_source_value if self.frozen_source_value is not None
+             else values[self.attr][lx, ly])
+        return jnp.asarray(self.flow_rate * v, dtype=dtype)
+
+    def outflow(self, values: dict[str, jax.Array],
+                origin: tuple[int, int] = (0, 0)) -> jax.Array:
+        z = jnp.zeros_like(values[self.attr])
+        lx, ly, inside = self.local_source(values, origin)
+        if not inside:
+            return z
+        return z.at[lx, ly].set(self.amount(values, origin))
+
+
+@dataclasses.dataclass
+class Exponencial(PointFlow):
+    """``execute() = flow_rate * source_value`` (``Exponencial.hpp:14-16``)."""
+
+    def execute_scalar(self, cell: Optional[Cell] = None) -> float:
+        """Host-side scalar parity with the reference's two overloads
+        (``Exponencial.hpp:14-20``)."""
+        if cell is not None:
+            return self.flow_rate * cell.attribute.value
+        if self.frozen_source_value is not None:
+            return self.flow_rate * self.frozen_source_value
+        raise ValueError("no source value snapshot; pass a cell")
+
+
+@dataclasses.dataclass
+class Diffusion(Flow):
+    """Every cell is a source: ``outflow = rate * value`` grid-wide.
+
+    The dense generalization used by the benchmark ladder (BASELINE configs
+    2-5) — one compiled step updates all cells, which is what
+    cell-updates/sec measures.
+    """
+
+    flow_rate: float = 0.1
+    attr: str = DEFAULT_ATTR
+
+    def outflow(self, values: dict[str, jax.Array],
+                origin: tuple[int, int] = (0, 0)) -> jax.Array:
+        return jnp.asarray(self.flow_rate, dtype=values[self.attr].dtype) * values[self.attr]
+
+
+@dataclasses.dataclass
+class Coupled(Flow):
+    """Outflow of ``attr`` modulated by another attribute channel:
+    ``outflow = rate * values[attr] * values[modulator]`` (BASELINE config 4:
+    multi-attribute cells with coupled flows)."""
+
+    flow_rate: float = 0.1
+    attr: str = DEFAULT_ATTR
+    modulator: str = DEFAULT_ATTR
+
+    def outflow(self, values: dict[str, jax.Array],
+                origin: tuple[int, int] = (0, 0)) -> jax.Array:
+        r = jnp.asarray(self.flow_rate, dtype=values[self.attr].dtype)
+        return r * values[self.attr] * values[self.modulator]
+
+
+def build_outflow(flows: Sequence[Flow], values: dict[str, jax.Array],
+                  origin: tuple[int, int] = (0, 0)) -> dict[str, jax.Array]:
+    """Sum the outflow fields of all flows, grouped by attribute channel."""
+    out: dict[str, jax.Array] = {}
+    for f in flows:
+        o = f.outflow(values, origin)
+        out[f.attr] = out[f.attr] + o if f.attr in out else o
+    return out

@@ -1,0 +1,76 @@
+"""Serial NumPy oracle: bit-exact golden reference for every backend.
+
+The reference repo has no tests (SURVEY §4); its one correctness contract is
+mass conservation under the sharded stencil update (``Model.hpp:88-95``).
+This module is the framework's independent ground truth: a deliberately
+naive, loop-free-but-unfused NumPy implementation of the exact same
+semantics as ``ops.stencil`` — used to golden-compare the JAX serial path,
+the sharded paths (1-D/2-D), the Pallas kernel, and the native C++ runtime.
+
+Kept free of any jax import so it cannot share bugs with the code under test.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .core.cell import MOORE_OFFSETS, moore_neighbors, neighbor_count_grid
+
+
+def shift2d_np(x: np.ndarray, dx: int, dy: int) -> np.ndarray:
+    out = np.zeros_like(x)
+    h, w = x.shape
+    xs, xe = max(0, -dx), min(h, h - dx)
+    ys, ye = max(0, -dy), min(w, w - dy)
+    out[xs:xe, ys:ye] = x[xs + dx:xe + dx, ys + dy:ye + dy]
+    return out
+
+
+def transport_np(values: np.ndarray, outflow: np.ndarray,
+                 counts: Optional[np.ndarray] = None,
+                 offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS) -> np.ndarray:
+    if counts is None:
+        counts = neighbor_count_grid(*values.shape, offsets=offsets,
+                                     dtype=values.dtype)
+    share = outflow / counts
+    inflow = np.zeros_like(values)
+    for dx, dy in offsets:
+        inflow += shift2d_np(share, dx, dy)
+    return values - outflow + inflow
+
+
+def dense_flow_step_np(values: np.ndarray, rate: float | np.ndarray,
+                       offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS) -> np.ndarray:
+    return transport_np(values, np.asarray(rate, dtype=values.dtype) * values,
+                        offsets=offsets)
+
+
+def point_flow_step_np(values: np.ndarray, x: int, y: int, amount: float,
+                       offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS) -> np.ndarray:
+    """Scalar-loop oracle of the reference's live step (``Model.hpp:176-235``):
+    source sheds ``amount``; each in-bounds neighbor gains ``amount/k`` where
+    k is the source's neighbor count."""
+    h, w = values.shape
+    neigh = moore_neighbors(x, y, h, w, offsets)
+    out = values.copy()
+    out[x, y] -= amount
+    for nx, ny in neigh:
+        out[nx, ny] += amount / len(neigh)
+    return out
+
+
+def reference_run_np(dim_x: int = 100, dim_y: int = 100,
+                     src: tuple[int, int] = (19, 3),
+                     snapshot_value: float = 2.2, rate: float = 0.1,
+                     init: float = 1.0, steps: int = 1,
+                     dtype=np.float64) -> np.ndarray:
+    """The reference's exact live run (``Main.cpp:25-35``): 100×100 grid of
+    1.0, one Exponencial step moving ``0.1 * 2.2`` out of (19, 3). The
+    snapshot value never updates (``Flow.hpp:22-28``), so every step moves
+    the same amount."""
+    values = np.full((dim_x, dim_y), init, dtype=dtype)
+    for _ in range(steps):
+        values = point_flow_step_np(values, *src, rate * snapshot_value)
+    return values

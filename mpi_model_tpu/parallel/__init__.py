@@ -1,0 +1,16 @@
+from .mesh import make_mesh, make_mesh_2d, shard_space
+from .halo import exchange_halo_1d, pad_with_halo_1d, pad_with_halo_2d
+from .collectives import global_sum
+from .executors import AutoShardedExecutor, ShardMapExecutor
+
+__all__ = [
+    "make_mesh",
+    "make_mesh_2d",
+    "shard_space",
+    "exchange_halo_1d",
+    "pad_with_halo_1d",
+    "pad_with_halo_2d",
+    "global_sum",
+    "AutoShardedExecutor",
+    "ShardMapExecutor",
+]

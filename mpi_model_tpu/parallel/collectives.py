@@ -1,0 +1,39 @@
+"""Collectives: typed replacements for the reference's hand-rolled loops.
+
+The reference implements every multi-rank pattern from blocking
+``MPI_Send``/``MPI_Recv``: pseudo-scatter (``Model.hpp:70-76``),
+pseudo-bcast (``:84-86``), pseudo-reduce (``:88-92``), pseudo-gather
+(``:110-130``) — no MPI collectives anywhere (SURVEY §2). Here each becomes
+the real XLA collective over ICI:
+
+- scatter  → ``parallel.mesh.shard_space`` (device_put with NamedSharding)
+- bcast    → replicated pytree args under jit (flow params are traced
+  scalars; no control messages exist)
+- reduce   → ``global_sum`` (``psum`` inside shard_map, or plain ``jnp.sum``
+  on a sharded array, which XLA lowers to an all-reduce)
+- gather   → ``gather_to_host`` (process-0 host fetch of the global array)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def global_sum(local: jax.Array, axis_names) -> jax.Array:
+    """Shard-local sum + psum over mesh axes: the conservation reduction
+    (``Model.hpp:88-95,238-243``) as one collective."""
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    s = jnp.sum(local)
+    for ax in axis_names:
+        s = lax.psum(s, ax)
+    return s
+
+
+def gather_to_host(x: jax.Array) -> np.ndarray:
+    """Fetch a (possibly sharded) global array to host memory — the typed
+    equivalent of the reference's per-rank file merge (``Model.hpp:110-131``)."""
+    return np.asarray(jax.device_get(x))

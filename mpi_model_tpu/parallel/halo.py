@@ -1,0 +1,84 @@
+"""Halo (ghost-cell) exchange over the device mesh.
+
+Rebuild of the reference's cross-rank ghost update — the owner of a
+boundary source sends ``(count, value, y)`` to ``rank+1`` in three blocking
+``MPI_Send``s and the neighbor adds into its first-row cells
+(``/root/reference/src/Model.hpp:189-235``). TPU-native design: inside a
+``shard_map``ped step, each shard ships its *edge rows/columns* to mesh
+neighbors with ``jax.lax.ppermute`` over ICI — the same neighbor-shift
+topology ring attention uses (SURVEY §5 long-context note). Non-periodic
+boundaries fall out of ppermute's semantics: a device no permutation pair
+targets receives **zeros**, which is exactly the zero-padding the stencil
+expects at true grid edges.
+
+The Moore (8-neighbor) corner problem on a 2-D mesh is solved with the
+standard two-stage exchange: first swap edge *columns* along the y-axis,
+then swap edge *rows of the column-augmented array* along the x-axis — the
+corner cells ride along in the second stage, so no diagonal permutes are
+needed (SURVEY §7 'hard parts').
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _fwd_perm(n: int) -> list[tuple[int, int]]:
+    """Pairs shipping shard i's data to shard i+1 (no wraparound)."""
+    return [(i, i + 1) for i in range(n - 1)]
+
+
+def _bwd_perm(n: int) -> list[tuple[int, int]]:
+    """Pairs shipping shard i's data to shard i-1 (no wraparound)."""
+    return [(i + 1, i) for i in range(n - 1)]
+
+
+def exchange_halo_1d(local: jax.Array, axis_name: str, axis_size: int,
+                     axis: int = 0) -> tuple[jax.Array, jax.Array]:
+    """Return (before_halo, after_halo) slabs for a 1-D sharded dimension.
+
+    ``before_halo`` is the neighbor-below's last slab (what the reference's
+    rank r receives from r-1), ``after_halo`` the neighbor-above's first.
+    Edge shards receive zeros (non-periodic grid).
+    """
+    n = axis_size
+    last = lax.slice_in_dim(local, local.shape[axis] - 1, local.shape[axis], axis=axis)
+    first = lax.slice_in_dim(local, 0, 1, axis=axis)
+    before = lax.ppermute(last, axis_name, _fwd_perm(n))
+    after = lax.ppermute(first, axis_name, _bwd_perm(n))
+    return before, after
+
+
+def pad_with_halo_1d(local: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """[h, w] shard → [h+2, w+2]: rows exchanged with mesh neighbors via
+    ppermute, columns zero-padded (unsharded dimension)."""
+    before, after = exchange_halo_1d(local, axis_name, axis_size, axis=0)
+    padded_rows = jnp.concatenate([before, local, after], axis=0)
+    return jnp.pad(padded_rows, ((0, 0), (1, 1)))
+
+
+def pad_with_halo_2d(local: jax.Array, ax_name: str, ay_name: str,
+                     nx: int, ny: int) -> jax.Array:
+    """[h, w] shard → [h+2, w+2] with a full 8-neighbor (edge + corner)
+    halo from the 2-D mesh: columns along ``ay`` first, then rows of the
+    augmented array along ``ax`` so corners ride along."""
+    left, right = exchange_halo_1d(local, ay_name, ny, axis=1)
+    aug = jnp.concatenate([left, local, right], axis=1)            # [h, w+2]
+    top, bottom = exchange_halo_1d(aug, ax_name, nx, axis=0)       # [1, w+2]
+    return jnp.concatenate([top, aug, bottom], axis=0)             # [h+2, w+2]
+
+
+def gather_from_padded(padded: jax.Array,
+                       offsets: Sequence[tuple[int, int]]) -> jax.Array:
+    """inflow[i, j] = Σ_d padded[1+i+dx, 1+j+dy] for an [h+2, w+2] padded
+    share array — the shard-local form of ``ops.stencil.gather_neighbors``."""
+    h, w = padded.shape[0] - 2, padded.shape[1] - 2
+    inflow = None
+    for dx, dy in offsets:
+        piece = lax.slice(padded, (1 + dx, 1 + dy), (1 + dx + h, 1 + dy + w))
+        inflow = piece if inflow is None else inflow + piece
+    return inflow

@@ -1,0 +1,31 @@
+"""Test rig: 8 virtual CPU devices so 'multi-chip' sharding is testable
+without a TPU (SURVEY §4 implication; the driver separately dry-runs the
+multi-chip path via __graft_entry__.dryrun_multichip)."""
+
+import os
+
+# Must be set before jax initializes.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# f64 on CPU so the oracle comparisons are bit-exact (BASELINE bit-match goal).
+jax.config.update("jax_enable_x64", True)
+# The image's sitecustomize force-registers a TPU backend regardless of
+# JAX_PLATFORMS; pin default execution to CPU so tests are hermetic and f64
+# is real f64 (the TPU emulates it lossily).
+jax.config.update("jax_default_device", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices("cpu")
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual CPU devices")
+    return devs

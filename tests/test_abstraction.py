@@ -1,0 +1,69 @@
+"""L0 dtype seam tests (reference Abstraction.hpp:23-76 behavior)."""
+
+import numpy as np
+import pytest
+
+from mpi_model_tpu.abstraction import (
+    DataType,
+    UnsupportedDataTypeError,
+    get_abstraction_data_type,
+    itemsize,
+    to_jax,
+    to_native,
+    to_numpy,
+)
+
+
+@pytest.mark.parametrize("tp,expect", [
+    (np.int8, DataType.INT8),
+    (np.uint8, DataType.UINT8),
+    (np.int16, DataType.INT16),
+    (np.uint16, DataType.UINT16),
+    (np.int32, DataType.INT32),
+    (np.uint32, DataType.UINT32),
+    (np.int64, DataType.INT64),
+    (np.uint64, DataType.UINT64),
+    (np.float32, DataType.FLOAT32),
+    (np.float64, DataType.FLOAT64),
+    ("bfloat16", DataType.BFLOAT16),
+    (float, DataType.FLOAT64),
+    (int, DataType.INT64),
+    (bool, DataType.BOOL),
+])
+def test_mapping(tp, expect):
+    assert get_abstraction_data_type(tp) == expect
+
+
+def test_unsupported_raises():
+    # Abstraction.hpp:24-26 throws on unsupported types.
+    with pytest.raises(UnsupportedDataTypeError):
+        get_abstraction_data_type("not-a-dtype-at-all")
+    with pytest.raises(UnsupportedDataTypeError):
+        get_abstraction_data_type(object)
+
+
+def test_roundtrip_numpy():
+    for dt in DataType:
+        if dt in (DataType.BFLOAT16,):
+            continue
+        assert get_abstraction_data_type(to_numpy(dt)) == dt
+
+
+def test_jax_conversion():
+    import jax.numpy as jnp
+
+    assert to_jax(DataType.FLOAT32) == jnp.float32
+    assert to_jax(DataType.BFLOAT16) == jnp.bfloat16
+
+
+def test_native_abi_tags_stable():
+    # The native runtime (native/include/mmtpu/abstraction.hpp) hardcodes
+    # these tag values; this pins the ABI.
+    assert to_native(DataType.INT8) == 0
+    assert to_native(DataType.FLOAT64) == 9
+    assert to_native(DataType.BFLOAT16) == 10
+
+
+def test_itemsize():
+    assert itemsize(DataType.FLOAT64) == 8
+    assert itemsize(DataType.BFLOAT16) == 2

@@ -1,0 +1,61 @@
+"""Neighborhood topology tests (reference Cell::SetNeighbor, Cell.hpp:71-157:
+4 corners → 3 neighbors, 4 edges → 5, interior → 8)."""
+
+import numpy as np
+import pytest
+
+from mpi_model_tpu.core import (
+    Attribute,
+    Cell,
+    MOORE_OFFSETS,
+    VON_NEUMANN_OFFSETS,
+    moore_neighbors,
+    neighbor_count_grid,
+)
+
+
+@pytest.mark.parametrize("x,y,expected", [
+    (0, 0, 3), (0, 99, 3), (99, 0, 3), (99, 99, 3),          # corners
+    (0, 50, 5), (99, 50, 5), (50, 0, 5), (50, 99, 5),        # edges
+    (50, 50, 8), (1, 1, 8), (19, 3, 8),                      # interior
+])
+def test_moore_counts_100x100(x, y, expected):
+    assert len(moore_neighbors(x, y, 100, 100)) == expected
+
+
+def test_neighbors_match_bruteforce():
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        h, w = rng.integers(1, 12, size=2)
+        x, y = rng.integers(0, h), rng.integers(0, w)
+        got = set(moore_neighbors(int(x), int(y), int(h), int(w)))
+        want = {
+            (i, j)
+            for i in range(h) for j in range(w)
+            if (i, j) != (x, y) and abs(i - x) <= 1 and abs(j - y) <= 1
+        }
+        assert got == want
+
+
+def test_neighbor_count_grid_matches_scalar():
+    counts = neighbor_count_grid(7, 9)
+    for i in range(7):
+        for j in range(9):
+            assert counts[i, j] == len(moore_neighbors(i, j, 7, 9))
+
+
+def test_neighbor_count_grid_von_neumann():
+    counts = neighbor_count_grid(5, 5, offsets=VON_NEUMANN_OFFSETS)
+    assert counts[0, 0] == 2 and counts[0, 2] == 3 and counts[2, 2] == 4
+
+
+def test_cell_set_neighbor_preserves_both_halves():
+    # The reference's copy drops the y-halves (Cell.hpp:33-35,45-47) — ours
+    # must keep (x, y) pairs intact.
+    c = Cell(19, 3, Attribute(99, 2.2)).set_neighbor(100, 100)
+    assert c.count_neighbors == 8
+    assert sorted(zip(c.neighbor_xs(), c.neighbor_ys())) == sorted(c.neighbors)
+    import copy
+
+    c2 = copy.deepcopy(c)
+    assert c2.neighbors == c.neighbors

@@ -1,0 +1,88 @@
+"""Stencil transport tests: conservation, oracle golden-match, shift semantics."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_model_tpu.core.cell import MOORE_OFFSETS, VON_NEUMANN_OFFSETS, neighbor_count_grid
+from mpi_model_tpu.ops.stencil import (
+    flow_step,
+    gather_neighbors,
+    point_flow_step,
+    shift2d,
+    transport,
+)
+from mpi_model_tpu import oracle
+
+
+def test_shift2d_matches_numpy():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(6, 8))
+    for dx in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            np.testing.assert_array_equal(
+                np.asarray(shift2d(jnp.asarray(x), dx, dy)),
+                oracle.shift2d_np(x, dx, dy))
+
+
+@pytest.mark.parametrize("offsets", [MOORE_OFFSETS, VON_NEUMANN_OFFSETS])
+def test_dense_step_conserves_mass(offsets):
+    rng = np.random.default_rng(2)
+    v = jnp.asarray(rng.uniform(0.5, 2.0, size=(33, 17)))
+    counts = jnp.asarray(neighbor_count_grid(33, 17, offsets))
+    out = flow_step(v, jnp.full_like(v, 0.07), counts, offsets)
+    assert abs(float(out.sum()) - float(v.sum())) < 1e-9
+
+
+def test_dense_step_matches_oracle():
+    rng = np.random.default_rng(3)
+    v = rng.uniform(0.0, 3.0, size=(40, 25))
+    counts = jnp.asarray(neighbor_count_grid(40, 25))
+    got = np.asarray(flow_step(jnp.asarray(v), jnp.full(v.shape, 0.1), counts))
+    want = oracle.dense_flow_step_np(v, 0.1)
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-12)
+
+
+def test_point_flow_matches_oracle_interior_and_boundary():
+    v = np.full((10, 10), 1.0)
+    counts = jnp.asarray(neighbor_count_grid(10, 10))
+    for (x, y) in [(5, 5), (0, 0), (0, 5), (9, 9), (9, 0), (3, 9)]:
+        got = np.asarray(point_flow_step(
+            jnp.asarray(v), jnp.array([x]), jnp.array([y]),
+            jnp.array([0.22]), counts))
+        want = oracle.point_flow_step_np(v, x, y, 0.22)
+        np.testing.assert_allclose(got, want, atol=1e-12)
+        assert abs(got.sum() - v.sum()) < 1e-9
+
+
+def test_point_flow_equals_dense_with_delta_rate():
+    # A point flow is the dense step with a one-hot rate field.
+    v = jnp.asarray(np.random.default_rng(4).uniform(1, 2, size=(12, 12)))
+    counts = jnp.asarray(neighbor_count_grid(12, 12))
+    rate = jnp.zeros((12, 12)).at[7, 4].set(0.3)
+    dense = flow_step(v, rate, counts)
+    amount = 0.3 * v[7, 4]
+    sparse = point_flow_step(v, jnp.array([7]), jnp.array([4]),
+                             amount[None], counts)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(sparse), atol=1e-12)
+
+
+def test_reference_invariant_exact():
+    """The reference's one live run: 100x100 grid of 1.0, amount 0.1*2.2
+    out of (19,3), sum stays 10000 (Model.hpp:88-95,155; Main.cpp:32-33)."""
+    v = jnp.full((100, 100), 1.0)
+    counts = jnp.asarray(neighbor_count_grid(100, 100))
+    out = point_flow_step(v, jnp.array([19]), jnp.array([3]),
+                          jnp.array([0.1 * 2.2]), counts)
+    out_np = np.asarray(out)
+    assert abs(out_np.sum() - 10000.0) < 1e-3  # the reference's assert
+    np.testing.assert_allclose(out_np, oracle.reference_run_np(), atol=1e-12)
+    assert out_np[19, 3] == pytest.approx(1.0 - 0.22)
+    assert out_np[18, 2] == pytest.approx(1.0 + 0.22 / 8)
+
+
+def test_gather_neighbors_counts():
+    ones = jnp.ones((9, 9))
+    # gathering a field of ones yields each cell's neighbor count
+    np.testing.assert_array_equal(
+        np.asarray(gather_neighbors(ones)), neighbor_count_grid(9, 9))
