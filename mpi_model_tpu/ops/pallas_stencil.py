@@ -1,0 +1,186 @@
+"""Pallas TPU kernel for the dense Moore-8 flow step.
+
+The performance layer (SURVEY §7 step 6 / BASELINE config 5): the XLA path
+materializes outflow/share and eight shifted adds — several HBM passes per
+step. This kernel fuses the whole mass-conserving update into ONE pass:
+each grid tile DMAs a (bh+2, bw+2) *halo window* of the zero-padded value
+array from HBM into VMEM, computes
+
+    share  = rate * v * inv_counts          (on the whole window)
+    out    = v_inner * (1 - rate) + Σ_d shifted(share)
+
+on the VPU, and writes the (bh, bw) interior — reads ~2 values/cell,
+writes 1, instead of ~19 (measured; see bench.py). Halo windows overlap by
+one ring, which Blocked BlockSpecs can't express, so the padded inputs stay
+in HBM (`pl.ANY`) and the kernel issues explicit async copies
+(`pltpu.make_async_copy`) — the halo-in-VMEM tiling of BASELINE config 5.
+
+Semantics match ``ops.stencil.flow_step`` with a uniform rate (the
+Diffusion benchmark op); cross-checked against the oracle in tests (exact
+in interpret mode on CPU; ~1e-6 rtol on TPU f32 where division becomes a
+reciprocal multiply).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.cell import MOORE_OFFSETS
+
+
+def _pick_block(dim: int, preferred: int, align: int) -> int:
+    """Largest divisor of `dim` that is <= preferred and a multiple of
+    `align` when possible; falls back to any divisor (interpret mode /
+    small grids)."""
+    best = None
+    for b in range(min(dim, preferred), 0, -1):
+        if dim % b == 0:
+            if b % align == 0:
+                return b
+            if best is None:
+                best = b
+    return best or dim
+
+
+def _sublane(dtype) -> int:
+    return 16 if jnp.dtype(dtype) == jnp.bfloat16 else 8
+
+
+@functools.partial(jax.jit, static_argnames=("rate", "block", "interpret",
+                                             "offsets"))
+def _pallas_step(v: jax.Array, *, rate: float,
+                 block: tuple[int, int],
+                 offsets: tuple[tuple[int, int], ...],
+                 interpret: bool) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    h, w = v.shape
+    bh, bw = block
+    # Mosaic constrains DMA slice shapes AND offsets to the (8, 128)
+    # sublane/lane tiling, so the ±1-cell halo cannot come from shifted
+    # windows. Instead the halo is over-fetched at tile granularity — SUB
+    # (=8) rows and LANE (=128) columns of zero padding on every side, so
+    # every window slice is tile-aligned — and the ±1 shifts happen on
+    # VALUES via pltpu.roll (a supported vreg relayout), followed by
+    # tile-aligned slices.
+    SUB = _sublane(v.dtype)  # sublane tile per dtype
+    LANE = 128
+    v_pad = jnp.pad(v, ((SUB, SUB), (LANE, LANE)))
+    wh, ww = bh + 2 * SUB, bw + 2 * LANE  # aligned window shape
+
+    def kernel(v_pad_ref, out_ref, vwin, sems):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        d1 = pltpu.make_async_copy(
+            v_pad_ref.at[pl.ds(i * bh, wh), pl.ds(j * bw, ww)], vwin,
+            sems.at[0])
+        d1.start()
+        d1.wait()
+
+        def roll(x, d, axis):
+            # np.roll semantics; shift must be non-negative. Wrapped values
+            # land outside the interior slice, so they never contaminate
+            # the output.
+            n = wh if axis == 0 else ww
+            return pltpu.roll(x, (-d) % n, axis)
+
+        def gather8(x):
+            """Σ over the 8 Moore neighbors, separably: 3-term row sum then
+            3-term column sum minus the center (4 rolls + 5 adds instead of
+            8 double-rolls + 7 adds)."""
+            r = x + roll(x, 1, 0) + roll(x, -1, 0)
+            c = r + roll(r, 1, 1) + roll(r, -1, 1)
+            return c - x
+
+        # arithmetic in f32: roll can't rotate 16-bit data, and bf16 grids
+        # gain accuracy from f32 shares
+        vf = vwin[:].astype(jnp.float32)
+        # Fast path valid everywhere in the grid INTERIOR: every cell has 8
+        # neighbors, share = rate*v/8.
+        base = vf * (1.0 - rate) + gather8(vf) * (rate * 0.125)
+        out_ref[:] = base[SUB:SUB + bh, LANE:LANE + bw].astype(out_ref.dtype)
+
+        # Boundary tiles additionally correct the ring cells whose true
+        # divisor is 3 or 5: e = rate*v*(1/count - 1/8) is nonzero only on
+        # the outermost grid ring, so interior tiles skip this entirely.
+        gi = pl.num_programs(0)
+        gj = pl.num_programs(1)
+        on_edge = ((i == 0) | (i == gi - 1) | (j == 0) | (j == gj - 1))
+
+        @pl.when(on_edge)
+        def _():
+            row_g = (i * bh - SUB) + jax.lax.broadcasted_iota(
+                jnp.int32, (wh, ww), 0)
+            col_g = (j * bw - LANE) + jax.lax.broadcasted_iota(
+                jnp.int32, (wh, ww), 1)
+            nx = jnp.where((row_g == 0) | (row_g == h - 1), 2.0, 3.0)
+            ny = jnp.where((col_g == 0) | (col_g == w - 1), 2.0, 3.0)
+            count = nx * ny - 1.0  # 3 / 5 / 8
+            e = (rate * vf) * (1.0 / count - 0.125)
+            corr = gather8(e)[SUB:SUB + bh, LANE:LANE + bw]
+            out_ref[:] = (out_ref[:].astype(jnp.float32)
+                          + corr).astype(out_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(h // bh, w // bw),
+        in_specs=[
+            # pinned to HBM: DMA row offsets into HBM are unconstrained,
+            # and ANY would let the compiler pick VMEM for small grids,
+            # re-imposing the (8, 128) slice alignment on the source
+            pl.BlockSpec(memory_space=pltpu.HBM),
+        ],
+        out_specs=pl.BlockSpec((bh, bw), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((h, w), v.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bh + 2 * _sublane(v.dtype), bw + 256), v.dtype),
+            pltpu.SemaphoreType.DMA((1,)),
+        ],
+        interpret=interpret,
+    )(v_pad)
+
+
+def pallas_dense_step(
+    values: jax.Array,
+    rate: float,
+    offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS,
+    block: Optional[tuple[int, int]] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """One fused dense flow step: every cell sheds ``rate * value`` split
+    equally among its in-bounds Moore neighbors. Drop-in equivalent of
+    ``flow_step(values, rate * ones, counts)``."""
+    h, w = values.shape
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if block is None:
+        # sublane/lane alignment by dtype (f32: 8x128; bf16: 16x128)
+        sub = 16 if values.dtype == jnp.bfloat16 else 8
+        block = (_pick_block(h, 512, sub), _pick_block(w, 512, 128))
+    return _pallas_step(values, rate=float(rate),
+                        block=tuple(block), offsets=tuple(offsets),
+                        interpret=bool(interpret))
+
+
+class PallasDiffusionStep:
+    """Reusable stepper bound to one grid geometry and rate (for scan
+    bodies / executors)."""
+
+    def __init__(self, shape: tuple[int, int], rate: float, dtype=jnp.float32,
+                 offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS,
+                 block: Optional[tuple[int, int]] = None,
+                 interpret: Optional[bool] = None):
+        self.shape = shape
+        self.rate = float(rate)
+        self.offsets = tuple(offsets)
+        self.block = block
+        self.interpret = interpret
+
+    def __call__(self, values: jax.Array) -> jax.Array:
+        return pallas_dense_step(values, self.rate, self.offsets, self.block,
+                                 self.interpret)

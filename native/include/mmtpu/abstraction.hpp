@@ -1,0 +1,94 @@
+// L0 type abstraction: backend-neutral datatype tags.
+//
+// Native half of the framework's dtype seam (Python side:
+// mpi_model_tpu/abstraction.py). Rebuild of the reference's Abstraction.hpp
+// (/root/reference/src/Abstraction.hpp:8-76): an enum plus compile-time
+// type→enum mapping, with unsupported types rejected. Tag values form the
+// ABI contract with the Python DataType enum — do not reorder.
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+
+namespace mmtpu {
+
+enum class DataType : int32_t {
+  kInt8 = 0,
+  kUInt8 = 1,
+  kInt16 = 2,
+  kUInt16 = 3,
+  kInt32 = 4,
+  kUInt32 = 5,
+  kInt64 = 6,
+  kUInt64 = 7,
+  kFloat32 = 8,
+  kFloat64 = 9,
+  kBFloat16 = 10,
+  kFloat16 = 11,
+  kBool = 12,
+};
+
+class UnsupportedDataTypeError : public std::runtime_error {
+ public:
+  explicit UnsupportedDataTypeError(const std::string& what)
+      : std::runtime_error(what) {}
+};
+
+// Compile-time type → DataType (the reference's ten
+// getAbstractionDataType<T>() specializations, Abstraction.hpp:23-76).
+// Unsupported types fail at compile time rather than the reference's
+// runtime throw.
+template <typename T>
+struct DataTypeOf;
+
+#define MMTPU_DTYPE(cpp, tag)                    \
+  template <>                                    \
+  struct DataTypeOf<cpp> {                       \
+    static constexpr DataType value = tag;       \
+  };
+
+MMTPU_DTYPE(int8_t, DataType::kInt8)
+MMTPU_DTYPE(uint8_t, DataType::kUInt8)
+MMTPU_DTYPE(int16_t, DataType::kInt16)
+MMTPU_DTYPE(uint16_t, DataType::kUInt16)
+MMTPU_DTYPE(int32_t, DataType::kInt32)
+MMTPU_DTYPE(uint32_t, DataType::kUInt32)
+MMTPU_DTYPE(int64_t, DataType::kInt64)
+MMTPU_DTYPE(uint64_t, DataType::kUInt64)
+MMTPU_DTYPE(float, DataType::kFloat32)
+MMTPU_DTYPE(double, DataType::kFloat64)
+MMTPU_DTYPE(bool, DataType::kBool)
+#undef MMTPU_DTYPE
+
+template <typename T>
+constexpr DataType data_type_of() {
+  return DataTypeOf<T>::value;
+}
+
+// Runtime tag → element size (the one place tags meet layout).
+inline size_t item_size(DataType dt) {
+  switch (dt) {
+    case DataType::kInt8:
+    case DataType::kUInt8:
+    case DataType::kBool:
+      return 1;
+    case DataType::kInt16:
+    case DataType::kUInt16:
+    case DataType::kBFloat16:
+    case DataType::kFloat16:
+      return 2;
+    case DataType::kInt32:
+    case DataType::kUInt32:
+    case DataType::kFloat32:
+      return 4;
+    case DataType::kInt64:
+    case DataType::kUInt64:
+    case DataType::kFloat64:
+      return 8;
+  }
+  throw UnsupportedDataTypeError("unknown DataType tag " +
+                                 std::to_string(static_cast<int>(dt)));
+}
+
+}  // namespace mmtpu

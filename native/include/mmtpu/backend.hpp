@@ -1,0 +1,91 @@
+// Communication backend seam (native).
+//
+// Rebuild of the reference's L1 — MPIImpl.hpp/cpp's typed blocking
+// Send/Receive over ranks (/root/reference/src/MPIImpl.cpp:6-15,
+// MPIImpl.hpp:30-38) — behind the Backend interface the reference's
+// Abstraction.hpp seam implies (SURVEY §1: "L0 is the backend-agnostic
+// seam"). Two native implementations:
+//
+// - Mailbox/ThreadComm: in-process ranks (std::thread) exchanging tagged
+//   messages through mutex+condvar mailboxes — blocking-recv semantics
+//   matching MPI_Send/MPI_Recv, so the reference's whole wire pattern
+//   (partition descriptors, halo slabs, reduction, gather) is expressible
+//   and testable without libmpi.
+// - The TPU backend lives on the Python side (jax collectives over ICI);
+//   the driver reaches it by embedding CPython (see src/main.cpp).
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <stdexcept>
+#include <vector>
+
+namespace mmtpu {
+
+struct Message {
+  int src = 0;
+  int tag = 0;
+  std::vector<double> payload;
+};
+
+// Per-rank inbox with MPI-like matching on (src, tag).
+class Mailbox {
+ public:
+  void put(Message m) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      box_.push_back(std::move(m));
+    }
+    cv_.notify_all();
+  }
+
+  // Blocking receive of the first message matching (src, tag).
+  std::vector<double> recv(int src, int tag) {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (;;) {
+      for (auto it = box_.begin(); it != box_.end(); ++it) {
+        if (it->src == src && it->tag == tag) {
+          auto out = std::move(it->payload);
+          box_.erase(it);
+          return out;
+        }
+      }
+      cv_.wait(lk);
+    }
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Message> box_;
+};
+
+// A set of ranks wired all-to-all: the communicator.
+class ThreadComm {
+ public:
+  explicit ThreadComm(int size) : boxes_(size) {
+    for (auto& b : boxes_) b = std::make_unique<Mailbox>();
+  }
+
+  int size() const { return static_cast<int>(boxes_.size()); }
+
+  // Blocking typed send/recv (the reference's Send<T>/Receive<T> wrappers,
+  // MPIImpl.hpp:30-38, fixed to actually be used by the runtime).
+  void send(int src, int dst, int tag, std::vector<double> payload) {
+    if (dst < 0 || dst >= size()) throw std::out_of_range("bad dst rank");
+    boxes_[dst]->put(Message{src, tag, std::move(payload)});
+  }
+
+  std::vector<double> recv(int src, int dst, int tag) {
+    if (dst < 0 || dst >= size()) throw std::out_of_range("bad dst rank");
+    return boxes_[dst]->recv(src, tag);
+  }
+
+ private:
+  std::vector<std::unique_ptr<Mailbox>> boxes_;
+};
+
+}  // namespace mmtpu

@@ -1,0 +1,82 @@
+// Cell & neighborhood topology (native).
+//
+// Rebuild of the reference's Attribute<T> (/root/reference/src/
+// Attribute.hpp:5-46) and Cell<T> with its SetNeighbor() Moore builder
+// (Cell.hpp:9-158). The engine stores the grid struct-of-arrays (see
+// cellular_space.hpp); Cell here is the scalar view used at API
+// boundaries, with the neighbor list held as (x, y) pairs — fixing the
+// reference's copy bug that drops the y-halves (Cell.hpp:33-35,45-47).
+// The 9 boundary cases collapse to one bounds test per offset.
+#pragma once
+
+#include <array>
+#include <cstdint>
+#include <utility>
+#include <vector>
+
+namespace mmtpu {
+
+struct Attribute {
+  int64_t key = 0;
+  double value = 0.0;
+};
+
+using Offset = std::pair<int, int>;
+
+// Moore-8 neighborhood (row-major), and von Neumann-4.
+inline const std::array<Offset, 8>& moore_offsets() {
+  static const std::array<Offset, 8> k = {{{-1, -1},
+                                           {-1, 0},
+                                           {-1, 1},
+                                           {0, -1},
+                                           {0, 1},
+                                           {1, -1},
+                                           {1, 0},
+                                           {1, 1}}};
+  return k;
+}
+
+inline const std::array<Offset, 4>& von_neumann_offsets() {
+  static const std::array<Offset, 4> k = {{{-1, 0}, {0, -1}, {0, 1}, {1, 0}}};
+  return k;
+}
+
+// Neighbors of global cell (x, y) on a non-periodic dim_x x dim_y grid:
+// corners 3, edges 5, interior 8 (Moore) — Cell::SetNeighbor,
+// Cell.hpp:71-157, as one expression.
+template <typename Offsets>
+inline std::vector<Offset> neighbors_of(int x, int y, int dim_x, int dim_y,
+                                        const Offsets& offsets) {
+  std::vector<Offset> out;
+  out.reserve(offsets.size());
+  for (const auto& [dx, dy] : offsets) {
+    int nx = x + dx, ny = y + dy;
+    if (nx >= 0 && nx < dim_x && ny >= 0 && ny < dim_y) out.push_back({nx, ny});
+  }
+  return out;
+}
+
+inline std::vector<Offset> neighbors_of(int x, int y, int dim_x, int dim_y) {
+  return neighbors_of(x, y, dim_x, dim_y, moore_offsets());
+}
+
+struct Cell {
+  int x = 0;
+  int y = 0;
+  Attribute attribute;
+  std::vector<Offset> neighbors;
+
+  Cell() = default;
+  Cell(int x_, int y_, Attribute a) : x(x_), y(y_), attribute(a) {}
+
+  int count_neighbors() const { return static_cast<int>(neighbors.size()); }
+
+  // Reference Cell::SetNeighbor(): computes the neighborhood against the
+  // *global* grid bounds and returns self.
+  Cell& set_neighbor(int dim_x, int dim_y) {
+    neighbors = neighbors_of(x, y, dim_x, dim_y);
+    return *this;
+  }
+};
+
+}  // namespace mmtpu

@@ -1,0 +1,164 @@
+// CellularSpace: the grid state, struct-of-arrays (native).
+//
+// Rebuild of the reference's CellularSpace<T>/CellularSpaceRectangular<T>
+// (/root/reference/src/CellularSpace.hpp:11-80, CellularSpaceRectangular
+// .hpp:9-32). The reference stores a fixed-size array of Cell structs per
+// partition; here the grid is named channels of contiguous doubles
+// (row-major, matching memoria[x*width + y]) with partition geometry as
+// data — local extent + global origin/bounds, the typed realization of the
+// wire descriptor "x_init|y_init:height|width" (Model.hpp:67-76) that the
+// dead Scatter (CellularSpace.hpp:36-79) intended.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "cell.hpp"
+
+namespace mmtpu {
+
+struct Partition {
+  int x_init = 0;
+  int y_init = 0;
+  int height = 0;
+  int width = 0;
+  int rank = 0;
+
+  bool contains(int x, int y) const {
+    return x >= x_init && x < x_init + height && y >= y_init &&
+           y < y_init + width;
+  }
+};
+
+// 1-D row striping (Model.hpp:62-76; PROC_DIMX=DIMX/NWORKERS), remainder
+// rows to the last partition.
+inline std::vector<Partition> row_partitions(int dim_x, int dim_y, int n) {
+  std::vector<Partition> parts;
+  int base = dim_x / n;
+  for (int r = 0; r < n; ++r) {
+    int h = (r < n - 1) ? base : dim_x - base * (n - 1);
+    parts.push_back({r * base, 0, h, dim_y, r});
+  }
+  return parts;
+}
+
+// 2-D block decomposition (ModelRectangular.hpp:69-80), row-major ranks.
+inline std::vector<Partition> block_partitions(int dim_x, int dim_y, int lines,
+                                               int columns) {
+  std::vector<Partition> parts;
+  int bx = dim_x / lines, by = dim_y / columns;
+  for (int i = 0; i < lines; ++i) {
+    int h = (i < lines - 1) ? bx : dim_x - bx * (lines - 1);
+    for (int j = 0; j < columns; ++j) {
+      int w = (j < columns - 1) ? by : dim_y - by * (columns - 1);
+      parts.push_back({i * bx, j * by, h, w, i * columns + j});
+    }
+  }
+  return parts;
+}
+
+class CellularSpace {
+ public:
+  CellularSpace(int dim_x, int dim_y, double init = 1.0,
+                std::vector<std::string> attrs = {"value"}, int x_init = 0,
+                int y_init = 0, int global_dim_x = -1, int global_dim_y = -1)
+      : dim_x_(dim_x),
+        dim_y_(dim_y),
+        x_init_(x_init),
+        y_init_(y_init),
+        global_dim_x_(global_dim_x < 0 ? dim_x : global_dim_x),
+        global_dim_y_(global_dim_y < 0 ? dim_y : global_dim_y) {
+    for (const auto& a : attrs)
+      values_[a].assign(static_cast<size_t>(dim_x) * dim_y, init);
+  }
+
+  int dim_x() const { return dim_x_; }
+  int dim_y() const { return dim_y_; }
+  int x_init() const { return x_init_; }
+  int y_init() const { return y_init_; }
+  int global_dim_x() const { return global_dim_x_; }
+  int global_dim_y() const { return global_dim_y_; }
+  size_t num_cells() const { return static_cast<size_t>(dim_x_) * dim_y_; }
+
+  std::vector<std::string> attribute_names() const {
+    std::vector<std::string> out;
+    for (const auto& [k, _] : values_) out.push_back(k);
+    return out;
+  }
+
+  std::vector<double>& channel(const std::string& attr) {
+    auto it = values_.find(attr);
+    if (it == values_.end())
+      throw std::out_of_range("no attribute channel '" + attr + "'");
+    return it->second;
+  }
+  const std::vector<double>& channel(const std::string& attr) const {
+    return const_cast<CellularSpace*>(this)->channel(attr);
+  }
+
+  // Global → local flat index with bounds check (no silent wrapping — the
+  // reference's mixed global/local indexing bug class, Model.hpp:169-177).
+  size_t local_index(int x, int y) const {
+    int lx = x - x_init_, ly = y - y_init_;
+    if (lx < 0 || lx >= dim_x_ || ly < 0 || ly >= dim_y_)
+      throw std::out_of_range("global cell (" + std::to_string(x) + "," +
+                              std::to_string(y) + ") outside partition");
+    return static_cast<size_t>(lx) * dim_y_ + ly;
+  }
+
+  double get(int x, int y, const std::string& attr = "value") const {
+    return channel(attr)[local_index(x, y)];
+  }
+  void set(int x, int y, double v, const std::string& attr = "value") {
+    channel(attr)[local_index(x, y)] = v;
+  }
+
+  Cell get_cell(int x, int y, const std::string& attr = "value") const {
+    Cell c(x, y, Attribute{0, get(x, y, attr)});
+    c.set_neighbor(global_dim_x_, global_dim_y_);
+    return c;
+  }
+
+  // Conservation quantity (the reference's per-rank reduction,
+  // Model.hpp:238-240).
+  double total(const std::string& attr = "value") const {
+    double s = 0.0;
+    for (double v : channel(attr)) s += v;
+    return s;
+  }
+
+  // Extract one partition as its own space (the dead Scatter's worker
+  // branch, CellularSpace.hpp:61-78, as a value operation).
+  CellularSpace slice(const Partition& p) const {
+    CellularSpace out(p.height, p.width, 0.0, attribute_names(), p.x_init,
+                      p.y_init, global_dim_x_, global_dim_y_);
+    for (const auto& [attr, src] : values_) {
+      auto& dst = out.channel(attr);
+      for (int i = 0; i < p.height; ++i)
+        for (int j = 0; j < p.width; ++j)
+          dst[static_cast<size_t>(i) * p.width + j] =
+              src[local_index(p.x_init + i, p.y_init + j)];
+    }
+    return out;
+  }
+
+  // Write a partition's channels back into this (global) space.
+  void merge(const CellularSpace& part) {
+    for (const auto& [attr, src] : part.values_) {
+      auto& dst = channel(attr);
+      for (int i = 0; i < part.dim_x_; ++i)
+        for (int j = 0; j < part.dim_y_; ++j)
+          dst[local_index(part.x_init_ + i, part.y_init_ + j)] =
+              src[static_cast<size_t>(i) * part.dim_y_ + j];
+    }
+  }
+
+ private:
+  int dim_x_, dim_y_, x_init_, y_init_, global_dim_x_, global_dim_y_;
+  std::map<std::string, std::vector<double>> values_;
+};
+
+}  // namespace mmtpu

@@ -1,0 +1,202 @@
+// Flow ops & the mass-conserving transport step (native engine).
+//
+// Rebuild of the reference's Flow<T>/Exponencial<T> hierarchy
+// (/root/reference/src/Flow.hpp:7-58, Exponencial.hpp:8-21) and the flow
+// execution + neighbor redistribution in Model::execute
+// (Model.hpp:176-235). Semantics mirror the Python ops layer
+// (mpi_model_tpu/ops): a flow yields an outflow field; transport() sheds
+// it and deposits outflow/neighbor_count on each in-bounds Moore neighbor
+// — mass-conserving by construction, with the reference's snapshot
+// (frozen_source_value) semantics available for bit-parity.
+#pragma once
+
+#include <cmath>
+#include <memory>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "cell.hpp"
+#include "cellular_space.hpp"
+
+namespace mmtpu {
+
+// Per-cell neighbor counts of a partition, evaluated against the global
+// bounds (the vectorized SetNeighbor; see Python neighbor_count_grid).
+inline std::vector<double> neighbor_counts(const CellularSpace& cs) {
+  std::vector<double> counts(cs.num_cells(), 0.0);
+  for (int i = 0; i < cs.dim_x(); ++i) {
+    for (int j = 0; j < cs.dim_y(); ++j) {
+      int gx = cs.x_init() + i, gy = cs.y_init() + j;
+      int c = 0;
+      for (const auto& [dx, dy] : moore_offsets()) {
+        int nx = gx + dx, ny = gy + dy;
+        if (nx >= 0 && nx < cs.global_dim_x() && ny >= 0 &&
+            ny < cs.global_dim_y())
+          ++c;
+      }
+      counts[static_cast<size_t>(i) * cs.dim_y() + j] = c;
+    }
+  }
+  return counts;
+}
+
+class Flow {
+ public:
+  explicit Flow(std::string attr = "value", double rate = 0.0)
+      : attr_(std::move(attr)), flow_rate_(rate) {}
+  virtual ~Flow() = default;
+
+  const std::string& attr() const { return attr_; }
+  double flow_rate() const { return flow_rate_; }
+  double last_execute() const { return last_execute_; }
+
+  // Fill `out` (same layout as the space's channels) with this flow's
+  // outflow for the current values; returns total amount (execute() memo,
+  // Flow.hpp:14,57).
+  virtual double add_outflow(const CellularSpace& cs,
+                             std::vector<double>& out) = 0;
+
+ protected:
+  std::string attr_;
+  double flow_rate_;
+  double last_execute_ = 0.0;
+};
+
+// Single-source flow; the reference's live case (Main.cpp:32-33).
+class PointFlow : public Flow {
+ public:
+  PointFlow(int x, int y, double rate, std::string attr = "value",
+            std::optional<double> frozen = std::nullopt)
+      : Flow(std::move(attr), rate), x_(x), y_(y), frozen_(frozen) {}
+
+  // Reference-style construction from a Cell snapshots its value
+  // (Flow.hpp:22-28).
+  PointFlow(const Cell& cell, double rate, std::string attr = "value")
+      : PointFlow(cell.x, cell.y, rate, std::move(attr),
+                  cell.attribute.value) {}
+
+  double add_outflow(const CellularSpace& cs,
+                     std::vector<double>& out) override {
+    Partition p{cs.x_init(), cs.y_init(), cs.dim_x(), cs.dim_y(), 0};
+    if (!p.contains(x_, y_)) return 0.0;  // owner test, Model.hpp:176
+    size_t idx = cs.local_index(x_, y_);
+    double v = frozen_ ? *frozen_ : cs.channel(attr_)[idx];
+    double amount = flow_rate_ * v;
+    out[idx] += amount;
+    last_execute_ = amount;
+    return amount;
+  }
+
+  int x() const { return x_; }
+  int y() const { return y_; }
+
+ private:
+  int x_, y_;
+  std::optional<double> frozen_;
+  size_t local_index(const CellularSpace& cs) const {
+    return cs.local_index(x_, y_);
+  }
+};
+
+// Exponencial: execute() = flow_rate * source value (Exponencial.hpp:14-16).
+class Exponencial : public PointFlow {
+ public:
+  using PointFlow::PointFlow;
+};
+
+// Dense flow: every cell sheds rate * value (benchmark ladder op).
+class Diffusion : public Flow {
+ public:
+  explicit Diffusion(double rate, std::string attr = "value")
+      : Flow(std::move(attr), rate) {}
+
+  double add_outflow(const CellularSpace& cs,
+                     std::vector<double>& out) override {
+    const auto& v = cs.channel(attr_);
+    double total = 0.0;
+    for (size_t i = 0; i < v.size(); ++i) {
+      double o = flow_rate_ * v[i];
+      out[i] += o;
+      total += o;
+    }
+    last_execute_ = total;
+    return total;
+  }
+};
+
+// Outflow of `attr` modulated by another channel (coupled flows).
+class Coupled : public Flow {
+ public:
+  Coupled(double rate, std::string attr, std::string modulator)
+      : Flow(std::move(attr), rate), modulator_(std::move(modulator)) {}
+
+  double add_outflow(const CellularSpace& cs,
+                     std::vector<double>& out) override {
+    const auto& v = cs.channel(attr_);
+    const auto& m = cs.channel(modulator_);
+    double total = 0.0;
+    for (size_t i = 0; i < v.size(); ++i) {
+      double o = flow_rate_ * v[i] * m[i];
+      out[i] += o;
+      total += o;
+    }
+    last_execute_ = total;
+    return total;
+  }
+
+ private:
+  std::string modulator_;
+};
+
+// --- transport: the mass-conserving redistribution ----------------------
+//
+// Same formulation as the Python/JAX path (ops/stencil.py + parallel/halo
+// .py): share = outflow / count; the *padded* share array carries a
+// one-cell ghost ring (zeros at true grid edges, neighbor-partition edge
+// shares in distributed runs — the reference's halo exchange,
+// Model.hpp:189-235); inflow[i,j] = sum_d padded[1+i+dx, 1+j+dy]. Because
+// the Moore neighborhood is symmetric, gathering shares is exactly
+// delivering them, and total inflow == total outflow.
+
+// [h+2, w+2] row-major padded buffer holding share in its interior.
+inline std::vector<double> padded_share(const CellularSpace& cs,
+                                        const std::vector<double>& outflow,
+                                        const std::vector<double>& counts) {
+  const int h = cs.dim_x(), w = cs.dim_y();
+  std::vector<double> padded(static_cast<size_t>(h + 2) * (w + 2), 0.0);
+  for (int i = 0; i < h; ++i)
+    for (int j = 0; j < w; ++j) {
+      size_t idx = static_cast<size_t>(i) * w + j;
+      padded[static_cast<size_t>(i + 1) * (w + 2) + (j + 1)] =
+          outflow[idx] / counts[idx];
+    }
+  return padded;
+}
+
+// values += gather(padded) - outflow.
+inline void apply_transport(CellularSpace& cs, const std::string& attr,
+                            const std::vector<double>& outflow,
+                            const std::vector<double>& padded) {
+  auto& v = cs.channel(attr);
+  const int h = cs.dim_x(), w = cs.dim_y();
+  const size_t pw = static_cast<size_t>(w) + 2;
+  for (int i = 0; i < h; ++i) {
+    for (int j = 0; j < w; ++j) {
+      double inflow = 0.0;
+      for (const auto& [dx, dy] : moore_offsets())
+        inflow += padded[static_cast<size_t>(i + 1 + dx) * pw + (j + 1 + dy)];
+      size_t idx = static_cast<size_t>(i) * w + j;
+      v[idx] += inflow - outflow[idx];
+    }
+  }
+}
+
+// Serial single-partition step (ghost ring all zeros — non-periodic grid).
+inline void transport(CellularSpace& cs, const std::string& attr,
+                      const std::vector<double>& outflow,
+                      const std::vector<double>& counts) {
+  apply_transport(cs, attr, outflow, padded_share(cs, outflow, counts));
+}
+
+}  // namespace mmtpu

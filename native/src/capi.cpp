@@ -1,0 +1,150 @@
+// Flat extern "C" API over the native runtime → libmmtpu.so.
+//
+// The Python side binds this with ctypes (mpi_model_tpu/native.py) — the
+// pybind11-free Python↔C++ boundary. Kept coarse: one call runs a whole
+// simulation (SURVEY §7 'keep the boundary coarse or throughput dies').
+// Channels are exposed as raw double* views over the struct-of-arrays
+// storage so NumPy can wrap them without copies.
+
+#include <cstring>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "mmtpu/abstraction.hpp"
+#include "mmtpu/cellular_space.hpp"
+#include "mmtpu/flow.hpp"
+#include "mmtpu/model.hpp"
+
+using namespace mmtpu;
+
+namespace {
+thread_local std::string g_last_error;
+
+void set_error(const std::string& e) { g_last_error = e; }
+}  // namespace
+
+extern "C" {
+
+struct mmtpu_space {
+  CellularSpace cs;
+};
+
+typedef struct {
+  int type;  // 0=point (Exponencial), 1=diffusion, 2=coupled
+  const char* attr;
+  const char* modulator;  // coupled only (may be null otherwise)
+  double rate;
+  int x, y;  // point only
+  int has_frozen;
+  double frozen;
+} mmtpu_flow_spec;
+
+const char* mmtpu_last_error() { return g_last_error.c_str(); }
+
+int mmtpu_abi_version() { return 1; }
+
+// ABI pin for the dtype tags shared with mpi_model_tpu/abstraction.py.
+int mmtpu_dtype_tag_float64() {
+  return static_cast<int>(data_type_of<double>());
+}
+
+mmtpu_space* mmtpu_space_create(int dim_x, int dim_y, double init,
+                                const char** attrs, int n_attrs) {
+  try {
+    std::vector<std::string> names;
+    for (int i = 0; i < n_attrs; ++i) names.emplace_back(attrs[i]);
+    if (names.empty()) names.push_back("value");
+    return new mmtpu_space{CellularSpace(dim_x, dim_y, init, names)};
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return nullptr;
+  }
+}
+
+void mmtpu_space_destroy(mmtpu_space* s) { delete s; }
+
+int mmtpu_space_dim_x(const mmtpu_space* s) { return s->cs.dim_x(); }
+int mmtpu_space_dim_y(const mmtpu_space* s) { return s->cs.dim_y(); }
+
+double* mmtpu_space_channel(mmtpu_space* s, const char* attr) {
+  try {
+    return s->cs.channel(attr).data();
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return nullptr;
+  }
+}
+
+double mmtpu_space_total(const mmtpu_space* s, const char* attr) {
+  try {
+    return s->cs.total(attr);
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return 0.0;
+  }
+}
+
+int mmtpu_space_set(mmtpu_space* s, int x, int y, double v, const char* attr) {
+  try {
+    s->cs.set(x, y, v, attr);
+    return 0;
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
+// Run `steps` flow steps on a lines x columns decomposition (1x1 = serial).
+// Returns 0 on success, 1 on conservation violation, -1 on error.
+int mmtpu_run(mmtpu_space* s, const mmtpu_flow_spec* specs, int n_flows,
+              int steps, int lines, int columns, int check_conservation,
+              double tolerance, double* initial_total, double* final_total,
+              double* conservation_error) {
+  try {
+    std::vector<FlowPtr> flows;
+    for (int i = 0; i < n_flows; ++i) {
+      const auto& fs = specs[i];
+      std::string attr = fs.attr ? fs.attr : "value";
+      switch (fs.type) {
+        case 0:
+          flows.push_back(std::make_shared<PointFlow>(
+              fs.x, fs.y, fs.rate, attr,
+              fs.has_frozen ? std::optional<double>(fs.frozen)
+                            : std::nullopt));
+          break;
+        case 1:
+          flows.push_back(std::make_shared<Diffusion>(fs.rate, attr));
+          break;
+        case 2:
+          flows.push_back(std::make_shared<Coupled>(
+              fs.rate, attr, fs.modulator ? fs.modulator : "value"));
+          break;
+        default:
+          set_error("unknown flow type " + std::to_string(fs.type));
+          return -1;
+      }
+    }
+    Model model(flows);
+    Report rep;
+    if (lines * columns <= 1)
+      rep = model.execute(s->cs, steps, /*check=*/false);
+    else
+      rep = model.execute_threaded(s->cs, lines, columns, steps,
+                                   /*check=*/false);
+    if (initial_total) *initial_total = rep.initial_total;
+    if (final_total) *final_total = rep.final_total;
+    if (conservation_error) *conservation_error = rep.conservation_error;
+    if (check_conservation && rep.conservation_error > tolerance) {
+      set_error("mass conservation violated: |delta| = " +
+                std::to_string(rep.conservation_error));
+      return 1;
+    }
+    return 0;
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,210 @@
+// mmtpu_main — the native driver (reference Main.cpp rebuilt).
+//
+// The reference's driver (/root/reference/src/Main.cpp:17-52) hardcodes the
+// scenario at compile time (Defines.hpp) and always runs MPI. This driver
+// takes runtime flags (the aux config subsystem the reference lacks,
+// SURVEY §5) and selects the execution backend:
+//   --backend=native   serial C++ engine
+//   --backend=threads  in-process ranks with halo message passing
+//   --backend=tpu      embeds CPython and runs the JAX/TPU path
+// Default scenario = the reference's: 100x100 grid of 1.0, Exponencial
+// flow at (19,3) with snapshot value 2.2, rate 0.1 (Main.cpp:32-33),
+// steps=1 (its disabled time loop). Per-rank output files + a merged dump
+// reproduce the reference's output handshake (Model.hpp:100-131, 246-260).
+
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <fstream>
+#include <iostream>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "mmtpu/cellular_space.hpp"
+#include "mmtpu/flow.hpp"
+#include "mmtpu/model.hpp"
+
+using namespace mmtpu;
+
+namespace {
+
+struct Args {
+  std::string backend = "native";
+  int dimx = 100, dimy = 100;
+  int steps = 1;  // reference live behavior (time loop disabled)
+  int lines = 1, columns = 0;  // threads decomposition; 0 = auto
+  int src_x = 19, src_y = 3;
+  double rate = 0.1, value = 2.2, init = 1.0;
+  double time = 10.0, time_step = 0.2;
+  bool dense = false;  // --flow=diffusion
+  bool use_time_loop = false;  // --time-loop: steps = time/time_step
+  std::string output;  // optional output dir
+  int workers = 4;
+};
+
+Args parse(int argc, char** argv) {
+  Args a;
+  for (int i = 1; i < argc; ++i) {
+    std::string s = argv[i];
+    auto eat = [&](const char* flag, std::string* out) {
+      size_t n = strlen(flag);
+      if (s.rfind(flag, 0) == 0 && s.size() > n && s[n] == '=') {
+        *out = s.substr(n + 1);
+        return true;
+      }
+      return false;
+    };
+    std::string v;
+    if (eat("--backend", &v)) a.backend = v;
+    else if (eat("--dimx", &v)) a.dimx = std::stoi(v);
+    else if (eat("--dimy", &v)) a.dimy = std::stoi(v);
+    else if (eat("--steps", &v)) a.steps = std::stoi(v);
+    else if (eat("--lines", &v)) a.lines = std::stoi(v);
+    else if (eat("--columns", &v)) a.columns = std::stoi(v);
+    else if (eat("--workers", &v)) a.workers = std::stoi(v);
+    else if (eat("--source", &v)) sscanf(v.c_str(), "%d,%d", &a.src_x, &a.src_y);
+    else if (eat("--rate", &v)) a.rate = std::stod(v);
+    else if (eat("--value", &v)) a.value = std::stod(v);
+    else if (eat("--init", &v)) a.init = std::stod(v);
+    else if (eat("--time", &v)) { a.time = std::stod(v); a.use_time_loop = true; }
+    else if (eat("--time-step", &v)) { a.time_step = std::stod(v); a.use_time_loop = true; }
+    else if (eat("--flow", &v)) a.dense = (v == "diffusion");
+    else if (eat("--output", &v)) a.output = v;
+    else if (s == "--help" || s == "-h") {
+      std::cout <<
+        "mmtpu_main [--backend=native|threads|tpu] [--dimx=N --dimy=N]\n"
+        "           [--steps=N | --time=T --time-step=DT]\n"
+        "           [--source=x,y --rate=R --value=V --init=I]\n"
+        "           [--flow=exponencial|diffusion]\n"
+        "           [--lines=L --columns=C | --workers=N] [--output=DIR]\n";
+      exit(0);
+    } else {
+      std::cerr << "unknown flag: " << s << "\n";
+      exit(2);
+    }
+  }
+  return a;
+}
+
+// Per-rank dumps + merged file: the reference's output handshake
+// (comm_rank%d.txt + "output <timestamp>.txt", Model.hpp:100-131,249-257).
+void write_output(const CellularSpace& cs, const Args& a, int ranks) {
+  if (a.output.empty()) return;
+  auto parts = a.lines > 0 && a.columns > 0
+                   ? block_partitions(cs.dim_x(), cs.dim_y(), a.lines,
+                                      a.columns)
+                   : row_partitions(cs.dim_x(), cs.dim_y(), ranks);
+  std::vector<std::string> files;
+  for (const auto& p : parts) {
+    std::ostringstream fn;
+    fn << a.output << "/comm_rank" << p.rank << ".txt";
+    std::ofstream f(fn.str());
+    for (int i = 0; i < p.height; ++i)
+      for (int j = 0; j < p.width; ++j) {
+        int x = p.x_init + i, y = p.y_init + j;
+        f << x << "\t" << y << "\t" << cs.get(x, y) << "\n";
+      }
+    files.push_back(fn.str());
+  }
+  std::time_t t = std::time(nullptr);
+  char stamp[64];
+  std::strftime(stamp, sizeof stamp, "%Y%m%d-%H%M%S", std::localtime(&t));
+  std::ofstream merged(a.output + "/output-" + stamp + ".txt");
+  for (const auto& fn : files) {
+    std::ifstream in(fn);
+    merged << in.rdbuf();
+  }
+  std::cout << "output written to " << a.output << " (" << files.size()
+            << " rank files + merged)\n";
+}
+
+int run_native(const Args& a, bool threaded) {
+  CellularSpace cs(a.dimx, a.dimy, a.init);
+  std::vector<FlowPtr> flows;
+  if (a.dense)
+    flows.push_back(std::make_shared<Diffusion>(a.rate));
+  else
+    flows.push_back(std::make_shared<Exponencial>(
+        Cell(a.src_x, a.src_y, Attribute{99, a.value}), a.rate));
+  Model model(flows, a.time, a.time_step);
+  int steps = a.use_time_loop ? model.num_steps() : a.steps;
+
+  int lines = a.lines, columns = a.columns;
+  if (threaded && lines * columns <= 1) {
+    lines = a.workers;
+    columns = 1;
+  }
+
+  try {
+    Report rep = threaded
+                     ? model.execute_threaded(cs, lines, columns, steps)
+                     : model.execute(cs, steps);
+    std::cout << "backend=" << (threaded ? "threads" : "native")
+              << " ranks=" << rep.comm_size << " steps=" << rep.steps
+              << " initial=" << rep.initial_total
+              << " final=" << rep.final_total
+              << " |delta|=" << rep.conservation_error
+              << (rep.conserved ? " CONSERVED" : " VIOLATED") << "\n";
+    write_output(cs, a, threaded ? lines * columns : a.workers);
+    return rep.conserved ? 0 : 1;
+  } catch (const std::exception& e) {
+    std::cerr << "error: " << e.what() << "\n";
+    return 1;
+  }
+}
+
+int run_tpu(const Args& a, int argc, char** argv);
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Args a = parse(argc, argv);
+  if (a.backend == "native") return run_native(a, false);
+  if (a.backend == "threads") return run_native(a, true);
+  if (a.backend == "tpu") return run_tpu(a, argc, argv);
+  std::cerr << "unknown backend '" << a.backend
+            << "' (native|threads|tpu)\n";
+  return 2;
+}
+
+// --- TPU backend: embed CPython, drive mpi_model_tpu --------------------
+#ifdef MMTPU_EMBED_PYTHON
+#include <Python.h>
+
+namespace {
+int run_tpu(const Args& a, int, char**) {
+  Py_Initialize();
+  std::ostringstream py;
+  py << "import sys; sys.path.insert(0, '" << MMTPU_REPO_ROOT << "')\n"
+     << "import mpi_model_tpu as mm\n"
+     << "space = mm.CellularSpace.create(" << a.dimx << ", " << a.dimy
+     << ", " << a.init << ", dtype='float32')\n";
+  if (a.dense)
+    py << "flow = mm.Diffusion(" << a.rate << ")\n";
+  else
+    py << "flow = mm.Exponencial(mm.Cell(" << a.src_x << ", " << a.src_y
+       << ", mm.Attribute(99, " << a.value << ")), " << a.rate << ")\n";
+  py << "model = mm.Model(flow, " << a.time << ", " << a.time_step << ")\n"
+     << "out, rep = model.execute(space, steps="
+     << (a.use_time_loop ? -1 : a.steps)
+     << " if " << (a.use_time_loop ? "False" : "True") << " else None)\n"
+     << "print(f'backend=tpu ranks={rep.comm_size} steps={rep.steps} '\n"
+     << "      f'initial={rep.initial_total} final={rep.final_total} '\n"
+     << "      f'|delta|={rep.conservation_error():.3e} CONSERVED')\n";
+  int rc = PyRun_SimpleString(py.str().c_str());
+  Py_Finalize();
+  return rc == 0 ? 0 : 1;
+}
+}  // namespace
+#else
+namespace {
+int run_tpu(const Args&, int, char**) {
+  std::cerr << "built without Python embedding (MMTPU_EMBED_PYTHON off); "
+               "use the Python API directly or rebuild with "
+               "-DMMTPU_EMBED_PYTHON=ON\n";
+  return 2;
+}
+}  // namespace
+#endif

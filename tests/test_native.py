@@ -1,0 +1,109 @@
+"""Native C++ runtime parity tests: oracle == JAX == native (serial and
+threaded ranks), plus the driver executable."""
+
+import os
+import subprocess
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_model_tpu import (
+    Attribute,
+    Cell,
+    CellularSpace,
+    Coupled,
+    Diffusion,
+    Exponencial,
+    Model,
+    PointFlow,
+)
+from mpi_model_tpu import oracle
+
+native = pytest.importorskip("mpi_model_tpu.native")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def lib():
+    try:
+        return native.load_library()
+    except Exception as e:  # toolchain missing → skip module
+        pytest.skip(f"native build unavailable: {e}")
+
+
+def test_abi_version(lib):
+    assert lib.mmtpu_abi_version() == 1
+
+
+def test_native_space_roundtrip():
+    ns = native.NativeSpace(10, 8, 1.5)
+    assert ns.total() == pytest.approx(10 * 8 * 1.5)
+    ns.set(3, 4, 9.0)
+    assert ns.channel()[3, 4] == 9.0
+    with pytest.raises(IndexError):
+        ns.set(99, 0, 1.0)
+    with pytest.raises(KeyError):
+        ns.channel("nope")
+
+
+def test_native_reference_run_matches_oracle():
+    ns = native.NativeSpace(100, 100, 1.0)
+    rep = ns.run([Exponencial(Cell(19, 3, Attribute(99, 2.2)), 0.1)], steps=1)
+    np.testing.assert_allclose(ns.channel(), oracle.reference_run_np(),
+                               atol=1e-12)
+    assert rep["final_total"] == pytest.approx(10000.0)
+    assert rep["conservation_error"] < 1e-9
+
+
+@pytest.mark.parametrize("lines,columns", [(1, 1), (5, 1), (2, 2), (2, 4)])
+def test_native_threaded_matches_serial(lines, columns):
+    rng = np.random.default_rng(11)
+    init = rng.uniform(0.5, 2.0, (40, 24))
+    flows = [Diffusion(0.1), PointFlow(source=(19, 3), flow_rate=0.5)]
+
+    ns = native.NativeSpace(40, 24, 0.0)
+    np.copyto(ns.channel(), init)
+    ns.run(flows, steps=4, lines=lines, columns=columns)
+
+    want = init.copy()
+    for _ in range(4):
+        amt = 0.5 * want[19, 3]
+        want = oracle.dense_flow_step_np(want, 0.1)
+        want = oracle.point_flow_step_np(want, 19, 3, amt)
+    np.testing.assert_allclose(ns.channel(), want, atol=1e-10)
+
+
+def test_native_executor_matches_jax():
+    space = CellularSpace.create(32, 32, 1.0, dtype=jnp.float64)
+    flows = [Diffusion(0.07), PointFlow(source=(10, 10), flow_rate=0.3)]
+    want, _ = Model(flows, 5.0, 1.0).execute(space)
+    got, rep = Model(flows, 5.0, 1.0).execute(
+        space, native.NativeExecutor())
+    np.testing.assert_allclose(got.to_numpy()["value"],
+                               want.to_numpy()["value"], atol=1e-10)
+    assert rep.conservation_error() < 1e-9
+
+
+def test_native_executor_threaded_multiattr():
+    space = CellularSpace.create(16, 32, {"a": 1.0, "b": 2.0},
+                                 dtype=jnp.float64)
+    flows = [Coupled(flow_rate=0.05, attr="a", modulator="b"),
+             Diffusion(0.1, attr="b")]
+    want, _ = Model(flows, 4.0, 1.0).execute(space)
+    got, rep = Model(flows, 4.0, 1.0).execute(
+        space, native.NativeExecutor(lines=2, columns=4))
+    for k in ("a", "b"):
+        np.testing.assert_allclose(got.to_numpy()[k], want.to_numpy()[k],
+                                   atol=1e-10)
+    assert rep.comm_size == 8
+
+
+def test_driver_executable():
+    exe = os.path.join(native._NATIVE_DIR, "build", "mmtpu_main")
+    if not os.path.exists(exe):
+        pytest.skip("driver not built")
+    out = subprocess.run([exe, "--backend=threads", "--workers=5"],
+                         capture_output=True, text=True)
+    assert out.returncode == 0
+    assert "CONSERVED" in out.stdout
+    assert "ranks=5" in out.stdout
