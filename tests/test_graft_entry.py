@@ -1,0 +1,46 @@
+"""Driver-contract regression tests for __graft_entry__.
+
+The round-1 failure mode (VERDICT weak #1): dryrun_multichip assumed the
+calling process already had n virtual CPU devices; in the driver's
+environment it had exactly one, so the 8-device mesh could never form.
+The rewrite bootstraps its own mesh in a subprocess with
+JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count set *before*
+jax import. These tests exercise both paths.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_entry_compiles():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert set(out) == {"a", "b"}
+
+
+def test_dryrun_in_process():
+    # conftest provisions 8 virtual CPU devices, so this runs in-process.
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_bootstraps_without_flags():
+    """From a parent with NO xla_force_host_platform_device_count (the
+    driver environment), dryrun_multichip must still produce a green
+    8-device run by re-exec'ing itself with the flag set."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_PLATFORM_NAME")}
+    env["PYTHONPATH"] = ROOT
+    code = ("import __graft_entry__ as g; g.dryrun_multichip(8); "
+            "print('GREEN')")
+    proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=ROOT,
+                          capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "GREEN" in proc.stdout
