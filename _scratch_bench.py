@@ -1,0 +1,57 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from mpi_model_tpu.ops.pallas_stencil import pallas_dense_step
+from mpi_model_tpu.oracle import dense_flow_step_np
+
+G = 8192
+tpu = jax.devices()[0]
+
+
+def marginal(mk_run, v0, s1=50, s2=250):
+    ts = {}
+    for steps in (s1, s2):
+        run = mk_run(steps)
+        out, s = run(v0); _ = float(s)
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out, s = run(v0)
+            _ = float(s)
+            best = min(best, time.perf_counter() - t0)
+        ts[steps] = best
+    return (ts[s2] - ts[s1]) / (s2 - s1)
+
+
+with jax.default_device(tpu):
+    # correctness on hardware first
+    rng = np.random.default_rng(0)
+    v = rng.uniform(0.5, 2.0, (512, 640)).astype(np.float32)
+    want = dense_flow_step_np(v.astype(np.float64), 0.1)
+    got = np.asarray(pallas_dense_step(jnp.asarray(v), 0.1,
+                                       interpret=False)).astype(np.float64)
+    print("TPU f32 err:", np.abs(got - want).max())
+    v2 = rng.uniform(0.5, 2.0, (1024, 2048)).astype(np.float32)
+    want2 = dense_flow_step_np(v2.astype(np.float64), 0.1)
+    got2 = np.asarray(pallas_dense_step(jnp.asarray(v2), 0.1,
+                                        interpret=False)).astype(np.float64)
+    print("TPU f32 multi-tile err:", np.abs(got2 - want2).max())
+
+    v0 = jnp.ones((G, G), dtype=jnp.bfloat16)
+    for block in [(256, 1024), (512, 512), (256, 512), (128, 1024),
+                  (256, 2048), (512, 1024)]:
+        def mk_pl(steps, block=block):
+            @jax.jit
+            def run(x):
+                def body(c, _):
+                    return pallas_dense_step(c, 0.1, block=block,
+                                             interpret=False), None
+                out, _ = jax.lax.scan(body, x, None, length=steps)
+                return out, jnp.sum(out.astype(jnp.float32))
+            return run
+        try:
+            t = marginal(mk_pl, v0)
+            print(f"pallas {block}: {t*1000:.3f} ms/step -> "
+                  f"{G*G/t/1e9:.1f}e9 CUPS")
+        except Exception as e:
+            print(f"pallas {block}: FAIL {str(e)[:70]}")

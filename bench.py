@@ -1,11 +1,22 @@
 """Benchmark: cell-updates/sec/chip on the dense Moore-8 flow step.
 
 Measures the framework's headline metric (BASELINE.json: cell-updates/sec/
-chip on RectangularModel; north star >=1e9 on a 1e8-cell grid) on the real
-TPU chip. Prints ONE JSON line:
+chip; north star >=1e9 on a 1e8-cell grid) on the real TPU chip, using the
+fused Pallas kernel (ops.pallas_stencil) with donated buffers, falling
+back to the XLA stencil path if the Pallas compile fails. Prints ONE JSON
+line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 vs_baseline is value / 1e9 (the north-star target — the reference itself
 publishes no numbers, SURVEY §6).
+
+Timing note: the remote-TPU tunnel adds ~100ms fixed dispatch overhead
+per call, so the per-step cost is measured MARGINALLY — two scan lengths
+(s1, s2), cost = (t(s2) - t(s1)) / (s2 - s1) — and completion is forced
+with an on-device reduction fetched to host (block_until_ready alone does
+not block through the tunnel).
+
+The full config ladder lives in benchmarks/ladder.py; this file is the
+driver's single-number entry point.
 """
 
 from __future__ import annotations
@@ -15,8 +26,41 @@ import sys
 import time
 
 
-def bench(grid: int = 8192, steps_per_call: int = 20, reps: int = 5,
-          dtype_name: str = "bfloat16", verbose: bool = False) -> dict:
+def _marginal_step_time(step, values, s1: int = 50, s2: int = 250,
+                        reps: int = 2) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    times = {}
+    for steps in (s1, s2):
+        def run_fn(v, _steps=steps):
+            def body(c, _):
+                return step(c), None
+            out, _ = jax.lax.scan(body, v, None, length=_steps)
+            # force real completion through the tunnel: tiny reduction
+            # fetched to host after the scan
+            return out, jnp.sum(
+                jax.tree.leaves(out)[0].astype(jnp.float32))
+        # donated carry buffers (SURVEY §7.6); donation consumes the input,
+        # so each rep runs on a fresh on-device copy made outside the
+        # timed region
+        run = jax.jit(run_fn, donate_argnums=0)
+        fresh = jax.tree.map(jnp.copy, values)
+        out, s = run(fresh)
+        _ = float(s)  # warmup / compile
+        best = float("inf")
+        for _ in range(reps):
+            fresh = jax.tree.map(jnp.copy, values)
+            t0 = time.perf_counter()
+            out, s = run(fresh)
+            _ = float(s)
+            best = min(best, time.perf_counter() - t0)
+        times[steps] = best
+    return (times[s2] - times[s1]) / (s2 - s1)
+
+
+def bench(grid: int = 8192, dtype_name: str = "bfloat16",
+          verbose: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -25,30 +69,25 @@ def bench(grid: int = 8192, steps_per_call: int = 20, reps: int = 5,
     dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
     space = CellularSpace.create(grid, grid, 1.0, dtype=dtype)
     model = Model(Diffusion(0.1), 1.0, 1.0)
-    step = model.make_step(space)
 
-    @jax.jit
-    def run(v):
-        def body(c, _):
-            return step(c), None
-        out, _ = jax.lax.scan(body, v, None, length=steps_per_call)
-        return out
-
-    values = dict(space.values)
-    # warmup / compile
-    out = jax.block_until_ready(run(values))
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = jax.block_until_ready(run(values))
-        dt = time.perf_counter() - t0
-        best = min(best, dt)
+    impl_used = "pallas"
+    try:
+        step = model.make_step(space, impl="pallas")
+        t = _marginal_step_time(step, dict(space.values))
+    except Exception as e:  # pallas compile/runtime failure → XLA fallback
         if verbose:
-            print(f"  {steps_per_call} steps in {dt:.4f}s", file=sys.stderr)
-    cups = grid * grid * steps_per_call / best
+            print(f"pallas path failed ({e}); falling back to XLA",
+                  file=sys.stderr)
+        impl_used = "xla"
+        step = model.make_step(space, impl="xla")
+        t = _marginal_step_time(step, dict(space.values))
+
+    cups = grid * grid / t
+    if verbose:
+        print(f"  impl={impl_used}: {t*1000:.3f} ms/step", file=sys.stderr)
     return {
         "metric": f"cell-updates/sec/chip (dense Moore-8 flow step, "
-                  f"{grid}x{grid} {dtype_name})",
+                  f"{grid}x{grid} {dtype_name}, {impl_used})",
         "value": cups,
         "unit": "cell-updates/s",
         "vs_baseline": cups / 1e9,

@@ -84,16 +84,22 @@ class SerialExecutor:
     """Single-device execution: ``jit(scan(step))`` (the reference's serial
     ``execute()`` stub, ``Model.hpp:47-51``, 'missing implement' — here
     implemented). The jitted runner is cached per (step, num_steps) so
-    repeated ``execute`` calls don't retrace."""
+    repeated ``execute`` calls don't retrace.
+
+    ``step_impl`` selects the per-step kernel: ``"xla"`` (fused stencil
+    ops), ``"pallas"`` (the fused TPU kernel — Diffusion-only field flows),
+    or ``"auto"`` (pallas when eligible).
+    """
 
     comm_size = 1
 
-    def __init__(self):
+    def __init__(self, step_impl: str = "xla"):
+        self.step_impl = step_impl
         self._cache: dict = {}
 
     def run_model(self, model: "Model", space: CellularSpace,
                   num_steps: int) -> Values:
-        step = model.make_step(space)
+        step = model.make_step(space, impl=self.step_impl)
         key = (step, num_steps)
         runner = self._cache.get(key)
         if runner is None:
@@ -140,7 +146,22 @@ class Model:
 
     # -- step construction -------------------------------------------------
 
-    def make_step(self, space: CellularSpace) -> Callable[[Values], Values]:
+    def pallas_rates(self) -> Optional[dict[str, float]]:
+        """attr → summed uniform rate when every field flow is a plain
+        ``Diffusion`` (the shape the fused Pallas kernel computes); None
+        when any field flow needs the general outflow path."""
+        from ..ops.flow import Diffusion
+        rates: dict[str, float] = {}
+        for f in self.flows:
+            if isinstance(f, PointFlow):
+                continue
+            if type(f) is not Diffusion:
+                return None
+            rates[f.attr] = rates.get(f.attr, 0.0) + f.flow_rate
+        return rates
+
+    def make_step(self, space: CellularSpace,
+                  impl: str = "xla") -> Callable[[Values], Values]:
         """Build the pure per-step function for this space's geometry.
 
         Point-source flows take the sparse scatter path
@@ -148,13 +169,22 @@ class Model:
         one-hot field over the grid); field flows take the dense transport.
         All amounts are computed from the pre-step values, so the result is
         identical to summing every flow's outflow field. Cached per
-        geometry so repeat executions reuse the same compiled step."""
+        geometry so repeat executions reuse the same compiled step.
+
+        ``impl`` selects the field-flow kernel: ``"xla"`` (stencil ops,
+        works for every flow), ``"pallas"`` (the fused one-HBM-pass TPU
+        kernel, ``ops.pallas_stencil`` — requires all field flows to be
+        plain ``Diffusion`` on a full non-partition grid; raises
+        ``ValueError`` otherwise), or ``"auto"`` (pallas when eligible,
+        else xla)."""
         if not jnp.issubdtype(space.dtype, jnp.floating):
             raise TypeError(
                 f"flow transport requires a floating dtype, got {space.dtype}"
                 " (integer channels are supported for storage/comm, not flows)")
+        if impl not in ("xla", "pallas", "auto"):
+            raise ValueError(f"unknown step impl {impl!r}")
         key = (space.shape, space.global_shape, (space.x_init, space.y_init),
-               str(space.dtype), self.offsets,
+               str(space.dtype), self.offsets, impl,
                tuple(f.fingerprint() for f in self.flows))
         cached = self._step_cache.get(key)
         if cached is not None:
@@ -173,21 +203,41 @@ class Model:
                               origin)[2]:
                 pt_by_attr.setdefault(f.attr, []).append(f)
 
+        pallas_steppers = None
+        if impl in ("pallas", "auto"):
+            rates = self.pallas_rates()
+            eligible = (rates is not None and not space.is_partition)
+            if impl == "pallas" and not eligible:
+                raise ValueError(
+                    "impl='pallas' requires all field flows to be plain "
+                    "Diffusion and a full (non-partition) grid; got "
+                    f"flows={[type(f).__name__ for f in self.flows]}, "
+                    f"is_partition={space.is_partition}. Use impl='xla' "
+                    "or 'auto'.")
+            if eligible:
+                from ..ops.pallas_stencil import PallasDiffusionStep
+                pallas_steppers = {
+                    attr: PallasDiffusionStep(space.shape, rate,
+                                              dtype=space.dtype,
+                                              offsets=offsets)
+                    for attr, rate in rates.items() if rate != 0.0}
+
         def step(values: Values) -> Values:
             new = dict(values)
-            outflow = build_outflow(field_flows, values, origin)
+            if pallas_steppers is not None:
+                for attr, stepper in pallas_steppers.items():
+                    new[attr] = stepper(values[attr])
+            else:
+                outflow = build_outflow(field_flows, values, origin)
+                for attr, o in outflow.items():
+                    new[attr] = transport(values[attr], o, counts, offsets)
             # Point amounts read the PRE-step values (matches summed-outflow
             # semantics: transport is linear in outflow).
-            pt_updates = {}
             for attr, pflows in pt_by_attr.items():
                 locs = [f.local_source(values, origin) for f in pflows]
                 xs = jnp.asarray([lx for lx, _, _ in locs])
                 ys = jnp.asarray([ly for _, ly, _ in locs])
                 amts = jnp.stack([f.amount(values, origin) for f in pflows])
-                pt_updates[attr] = (xs, ys, amts)
-            for attr, o in outflow.items():
-                new[attr] = transport(values[attr], o, counts, offsets)
-            for attr, (xs, ys, amts) in pt_updates.items():
                 new[attr] = point_flow_step(new[attr], xs, ys, amts, counts,
                                             offsets)
             return new

@@ -1,4 +1,5 @@
 from .flow import Coupled, Diffusion, Exponencial, Flow, PointFlow, build_outflow
+from .pallas_stencil import PallasDiffusionStep, pallas_dense_step
 from .stencil import flow_step, point_flow_step, shift2d, transport
 
 __all__ = [
@@ -12,4 +13,6 @@ __all__ = [
     "transport",
     "flow_step",
     "point_flow_step",
+    "pallas_dense_step",
+    "PallasDiffusionStep",
 ]
