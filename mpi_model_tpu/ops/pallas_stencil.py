@@ -44,6 +44,7 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..core.cell import MOORE_OFFSETS
@@ -124,10 +125,17 @@ def _pallas_step(v: jax.Array, *, rate: float,
         # tile n+1 is DMA'd (into slot (n+1)%2) while tile n computes
         # (from slot n%2) — the double-buffered pipeline the pallas grid
         # does not provide for overlapping (un-BlockSpec-able) windows.
+        # All scalar index arithmetic sticks to concrete int32 operands:
+        # under jax_enable_x64 a bare Python literal becomes a weak i64
+        # constant — lax.rem then type-errors outright (round-2 ADVICE
+        # high), and even jnp's promoting % plants an i64→i32
+        # convert_element_type inside the kernel, which Mosaic's scalar
+        # lowering recurses on forever.
+        _i32 = np.int32
         i = pl.program_id(0)
         j = pl.program_id(1)
-        n = i * gj + j
-        slot = lax.rem(n, 2)
+        n = i * _i32(gj) + j
+        slot = lax.rem(n, _i32(2))
         r0 = i * bh
         c0 = j * bw
 
@@ -177,7 +185,7 @@ def _pallas_step(v: jax.Array, *, rate: float,
                 cp = pltpu.make_async_copy(
                     v_ref.at[ds(sr, nr, row_m), ds(sc, nc, col_m)],
                     vwin.at[sl, pl.ds(dr, nr), pl.ds(dc, nc)],
-                    sems.at[sl, p])
+                    sems.at[sl, _i32(p)])
                 out.append((cond, cp))
             return out
 
@@ -213,10 +221,10 @@ def _pallas_step(v: jax.Array, *, rate: float,
         def _():
             start_fetch(i, j, slot)
 
-        nn = n + 1
-        ii = nn // gj
-        jj = lax.rem(nn, gj)
-        start_fetch(ii, jj, lax.rem(nn, 2), guard=nn < ntiles)
+        nn = n + _i32(1)
+        ii = lax.div(nn, _i32(gj))
+        jj = lax.rem(nn, _i32(gj))
+        start_fetch(ii, jj, lax.rem(nn, _i32(2)), guard=nn < _i32(ntiles))
         wait_fetch(i, j, slot)
 
         # ±1 shifts are STATIC slices of the VMEM window — Mosaic lowers
@@ -306,6 +314,44 @@ def _pallas_step(v: jax.Array, *, rate: float,
     )(v)
 
 
+def resolve_interpret(values=None) -> bool:
+    """Interpret mode iff the data will execute on CPU.
+
+    Resolved from the array's committed devices when concrete, else from
+    ``jax_default_device`` (a process can register a TPU backend while
+    pinning execution to CPU via that config — the test rig does), else
+    the process-wide default backend (round-2 ADVICE medium)."""
+    if values is not None:
+        try:
+            devs = values.devices()
+            if devs:
+                return all(d.platform == "cpu" for d in devs)
+        except Exception:
+            pass  # tracers/abstract values carry no device
+    dev = jax.config.jax_default_device
+    if dev is not None:
+        plat = dev if isinstance(dev, str) else getattr(dev, "platform", None)
+        if plat is not None:
+            return plat == "cpu"
+    return jax.default_backend() == "cpu"
+
+
+def _validate_block(h: int, w: int,
+                    block: tuple[int, int]) -> tuple[int, int]:
+    """Clamp an oversized block to the grid, then require exact tiling —
+    a non-divisor block would silently leave remainder cells uncomputed
+    (the pallas grid floor-divides; round-2 ADVICE medium)."""
+    bh = min(int(block[0]), h)
+    bw = min(int(block[1]), w)
+    if bh <= 0 or bw <= 0:
+        raise ValueError(f"block must be positive, got {block}")
+    if h % bh or w % bw:
+        raise ValueError(
+            f"block {(bh, bw)} does not tile grid {(h, w)} exactly; pick "
+            f"divisors of the grid dims (or pass block=None to auto-pick)")
+    return bh, bw
+
+
 def pallas_dense_step(
     values: jax.Array,
     rate: float,
@@ -319,13 +365,15 @@ def pallas_dense_step(
     offsets = check_offsets(offsets)
     h, w = values.shape
     if interpret is None:
-        interpret = jax.default_backend() == "cpu"
+        interpret = resolve_interpret(values)
     if block is None:
         sub = _sublane(values.dtype)
         # (512, 512) benches fastest at 8192^2 on v5e; double-buffered
         # windows + f32 compute temporaries must fit the ~16MB scoped-VMEM
         # budget, which (512, 512) does for both f32 and bf16
         block = (_pick_block(h, 512, sub), _pick_block(w, 512, LANE))
+    else:
+        block = _validate_block(h, w, block)
     return _pallas_step(values, rate=float(rate),
                         block=tuple(block), offsets=offsets,
                         interpret=bool(interpret))
