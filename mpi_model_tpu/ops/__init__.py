@@ -1,5 +1,9 @@
 from .flow import Coupled, Diffusion, Exponencial, Flow, PointFlow, build_outflow
-from .pallas_stencil import PallasDiffusionStep, pallas_dense_step
+from .pallas_stencil import (
+    PallasDiffusionStep,
+    pallas_dense_step,
+    pallas_halo_step,
+)
 from .stencil import flow_step, point_flow_step, shift2d, transport
 
 __all__ = [
@@ -14,5 +18,6 @@ __all__ = [
     "flow_step",
     "point_flow_step",
     "pallas_dense_step",
+    "pallas_halo_step",
     "PallasDiffusionStep",
 ]

@@ -89,38 +89,67 @@ def check_offsets(offsets: Sequence[tuple[int, int]]) -> tuple:
     return off
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("rate", "block", "offsets", "interpret"))
-def _pallas_step(v: jax.Array, *, rate: float,
-                 block: tuple[int, int],
-                 offsets: tuple[tuple[int, int], ...],
-                 interpret: bool) -> jax.Array:
+def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
+                  global_shape):
+    """Build and invoke the fused-stencil ``pallas_call``.
+
+    Two modes share the window/pipeline machinery:
+
+    - **dense** (``halo_operands is None``): self-contained full grid —
+      the zeroed scratch border is the non-periodic boundary, and the
+      divisor correction runs from static tile coordinates.
+    - **halo** (sharded; ``halo_operands = (nslab, sslab, wfull, efull,
+      origin)``): the shard's one-cell ghost ring arrives pre-padded to
+      the window's piece granularity (row slabs ``[hr, w]`` with the
+      ghost row innermost; column slabs ``[h + 2*hr, hc]`` whose hr-row
+      end caps carry the corner ghost cells). Border pieces DMA from a
+      slab instead of being zeroed, and the divisor correction evaluates
+      GLOBAL coordinates (``origin`` scalars + local index, SMEM) against
+      the static ``global_shape`` — a shard edge is only treated as a
+      grid edge when it actually is one. This is how the fused kernel
+      composes with ``shard_map``'s ppermute ring (SURVEY §7 "Pallas at
+      16384^2"): ppermute's zero-fill at true grid edges reproduces
+      exactly the zero border the dense kernel builds for itself.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    halo = halo_operands is not None
     h, w = v.shape
     bh, bw = block
     SUB = _sublane(v.dtype)
     # Halo strip sizes: SUB rows / LANE cols for Mosaic DMA alignment, but
     # never wider than one block (the neighbor tile a strip reads from), so
-    # small grids stay in bounds. gi/gj are static: single-tile axes emit
-    # no halo copies at all and rely on the zeroed scratch border.
+    # small grids stay in bounds. gi/gj are static: in dense mode
+    # single-tile axes emit no border copies at all and rely on the zeroed
+    # scratch border; in halo mode every border piece always fetches
+    # (from the shard interior or from a slab).
     gi, gj = h // bh, w // bw
     hr = min(SUB, bh)
     hc = min(LANE, bw)
     wh, ww = bh + 2 * hr, bw + 2 * hc  # window shape
-    n_pieces = 1 + 2 * (gi > 1) + 2 * (gj > 1) + 4 * (gi > 1 and gj > 1)
+    if halo:
+        n_pieces = 9
+    else:
+        n_pieces = 1 + 2 * (gi > 1) + 2 * (gj > 1) + 4 * (gi > 1 and gj > 1)
     is_moore = set(offsets) == set(MOORE_OFFSETS)
     k = float(len(offsets))
+    H, W = (h, w) if global_shape is None else global_shape
 
     # Every row start is a multiple of gcd(bh, hr) by construction
-    # (i*bh, i*bh - hr, i*bh + bh); Mosaic's divisibility prover can't
-    # derive that through the subtraction, so assert it explicitly.
+    # (i*bh, i*bh - hr, i*bh + bh, and the slab forms i*bh + hr,
+    # i*bh + bh + hr); Mosaic's divisibility prover can't derive that
+    # through the subtraction, so assert it explicitly.
     row_m = math.gcd(bh, hr)
     col_m = math.gcd(bw, hc)
     ntiles = gi * gj
 
-    def kernel(v_ref, out_ref, vwin, sems):
+    def kernel(*refs):
+        if halo:
+            (v_ref, n_ref, s_ref, wf_ref, ef_ref, orig_ref,
+             out_ref, vwin, sems) = refs
+        else:
+            v_ref, out_ref, vwin, sems = refs
         # vwin/sems carry a leading slot dimension of 2: the window for
         # tile n+1 is DMA'd (into slot (n+1)%2) while tile n computes
         # (from slot n%2) — the double-buffered pipeline the pallas grid
@@ -140,64 +169,120 @@ def _pallas_step(v: jax.Array, *, rate: float,
         c0 = j * bw
 
         def ds(start, size, m):
+            # literal starts (the slab fetches' 0s) must be pinned to
+            # int32 — under x64 a bare Python int reaches tpu.memref_slice
+            # as i64, which Mosaic rejects
+            if isinstance(start, (int, np.integer)):
+                return pl.ds(_i32(start), size)
             if m > 1:
                 start = pl.multiple_of(start, m)
             return pl.ds(start, size)
 
         def pieces_for(ti, tj):
-            """Up to nine clamped window pieces for tile (ti, tj): centre,
-            N/S/E/W halo strips, four corner blocks. Out-of-bounds sources
-            (negative offsets on perimeter tiles) are never started —
-            pl.when guards them — and must NOT be clamped with max():
-            Mosaic proves HBM slice offsets divisible by the (sublane,
-            lane) tiling from the index algebra, which a max() breaks.
-            Interpret mode clamps via dynamic_slice."""
+            """Window pieces for tile (ti, tj): (dr, dc, nr, nc,
+            variants), variants = [(cond, src_ref, sr, sc), ...].
+            Out-of-bounds sources (negative offsets on perimeter tiles)
+            are never started — pl.when guards them — and must NOT be
+            clamped with max(): Mosaic proves HBM slice offsets divisible
+            by the (sublane, lane) tiling from the index algebra, which a
+            max() breaks. In halo mode each piece's variant set is a
+            partition of tile positions, so exactly one variant runs."""
             tr = ti * bh
             tc = tj * bw
-            ps = [(None, tr, tc, hr, hc, bh, bw)]                    # centre
+            ps = [(hr, hc, bh, bw, [(None, v_ref, tr, tc)])]      # centre
+            if halo:
+                ps += [
+                    # N/S strips: interior tiles read the shard, edge
+                    # tiles the exchanged row slabs
+                    (0, hc, hr, bw,                               # N
+                     [(ti > 0, v_ref, tr - hr, tc),
+                      (ti == 0, n_ref, 0, tc)]),
+                    (hr + bh, hc, hr, bw,                         # S
+                     [(ti < gi - 1, v_ref, tr + bh, tc),
+                      (ti == gi - 1, s_ref, 0, tc)]),
+                    # W/E strips: column slabs span window rows
+                    # [-hr, h + hr), i.e. shard row r sits at slab row
+                    # r + hr
+                    (hr, 0, bh, hc,                               # W
+                     [(tj > 0, v_ref, tr, tc - hc),
+                      (tj == 0, wf_ref, tr + hr, 0)]),
+                    (hr, hc + bw, bh, hc,                         # E
+                     [(tj < gj - 1, v_ref, tr, tc + bw),
+                      (tj == gj - 1, ef_ref, tr + hr, 0)]),
+                    # corners: three-way — shard interior, row slab, or
+                    # column slab (whose end caps hold the corner cells)
+                    (0, 0, hr, hc,                                # NW
+                     [((ti > 0) & (tj > 0), v_ref, tr - hr, tc - hc),
+                      ((ti == 0) & (tj > 0), n_ref, 0, tc - hc),
+                      (tj == 0, wf_ref, tr, 0)]),
+                    (0, hc + bw, hr, hc,                          # NE
+                     [((ti > 0) & (tj < gj - 1), v_ref, tr - hr, tc + bw),
+                      ((ti == 0) & (tj < gj - 1), n_ref, 0, tc + bw),
+                      (tj == gj - 1, ef_ref, tr, 0)]),
+                    (hr + bh, 0, hr, hc,                          # SW
+                     [((ti < gi - 1) & (tj > 0), v_ref, tr + bh, tc - hc),
+                      ((ti == gi - 1) & (tj > 0), s_ref, 0, tc - hc),
+                      (tj == 0, wf_ref, tr + bh + hr, 0)]),
+                    (hr + bh, hc + bw, hr, hc,                    # SE
+                     [((ti < gi - 1) & (tj < gj - 1),
+                       v_ref, tr + bh, tc + bw),
+                      ((ti == gi - 1) & (tj < gj - 1),
+                       s_ref, 0, tc + bw),
+                      (tj == gj - 1, ef_ref, tr + bh + hr, 0)]),
+                ]
+                return ps
             if gi > 1:
                 ps += [
-                    (ti > 0, tr - hr, tc, 0, hc, hr, bw),            # N
-                    (ti < gi - 1, tr + bh, tc, hr + bh, hc, hr, bw),  # S
+                    (0, hc, hr, bw,
+                     [(ti > 0, v_ref, tr - hr, tc)]),             # N
+                    (hr + bh, hc, hr, bw,
+                     [(ti < gi - 1, v_ref, tr + bh, tc)]),        # S
                 ]
             if gj > 1:
                 ps += [
-                    (tj > 0, tr, tc - hc, hr, 0, bh, hc),            # W
-                    (tj < gj - 1, tr, tc + bw, hr, hc + bw, bh, hc),  # E
+                    (hr, 0, bh, hc,
+                     [(tj > 0, v_ref, tr, tc - hc)]),             # W
+                    (hr, hc + bw, bh, hc,
+                     [(tj < gj - 1, v_ref, tr, tc + bw)]),        # E
                 ]
             if gi > 1 and gj > 1:
                 ps += [
-                    ((ti > 0) & (tj > 0),
-                     tr - hr, tc - hc, 0, 0, hr, hc),                # NW
-                    ((ti > 0) & (tj < gj - 1),
-                     tr - hr, tc + bw, 0, hc + bw, hr, hc),          # NE
-                    ((ti < gi - 1) & (tj > 0),
-                     tr + bh, tc - hc, hr + bh, 0, hr, hc),          # SW
-                    ((ti < gi - 1) & (tj < gj - 1),
-                     tr + bh, tc + bw, hr + bh, hc + bw, hr, hc),    # SE
+                    (0, 0, hr, hc,
+                     [((ti > 0) & (tj > 0), v_ref, tr - hr, tc - hc)]),
+                    (0, hc + bw, hr, hc,
+                     [((ti > 0) & (tj < gj - 1), v_ref, tr - hr, tc + bw)]),
+                    (hr + bh, 0, hr, hc,
+                     [((ti < gi - 1) & (tj > 0), v_ref, tr + bh, tc - hc)]),
+                    (hr + bh, hc + bw, hr, hc,
+                     [((ti < gi - 1) & (tj < gj - 1),
+                       v_ref, tr + bh, tc + bw)]),
                 ]
             return ps
 
         def copies_for(ti, tj, sl):
             out = []
-            for p, (cond, sr, sc, dr, dc, nr, nc) in enumerate(
+            for p, (dr, dc, nr, nc, variants) in enumerate(
                     pieces_for(ti, tj)):
-                cp = pltpu.make_async_copy(
-                    v_ref.at[ds(sr, nr, row_m), ds(sc, nc, col_m)],
-                    vwin.at[sl, pl.ds(dr, nr), pl.ds(dc, nc)],
-                    sems.at[sl, _i32(p)])
-                out.append((cond, cp))
+                for cond, ref, sr, sc in variants:
+                    cp = pltpu.make_async_copy(
+                        ref.at[ds(sr, nr, row_m), ds(sc, nc, col_m)],
+                        vwin.at[sl, pl.ds(dr, nr), pl.ds(dc, nc)],
+                        sems.at[sl, _i32(p)])
+                    out.append((cond, cp))
             return out
 
         def start_fetch(ti, tj, sl, guard=None):
-            # perimeter tiles have clipped windows: zero the slot first so
-            # the unfilled border acts as the non-periodic zero padding
-            clipped = ((ti == 0) | (ti == gi - 1)
-                       | (tj == 0) | (tj == gj - 1))
+            if not halo:
+                # dense mode: perimeter tiles have clipped windows — zero
+                # the slot first so the unfilled border acts as the
+                # non-periodic zero padding (halo mode fills every piece,
+                # and ppermute already zero-fills true grid edges)
+                clipped = ((ti == 0) | (ti == gi - 1)
+                           | (tj == 0) | (tj == gj - 1))
 
-            @pl.when(clipped if guard is None else (guard & clipped))
-            def _():
-                vwin[sl] = jnp.zeros((wh, ww), vwin.dtype)
+                @pl.when(clipped if guard is None else (guard & clipped))
+                def _():
+                    vwin[sl] = jnp.zeros((wh, ww), vwin.dtype)
 
             for cond, cp in copies_for(ti, tj, sl):
                 g = guard if cond is None else (
@@ -208,6 +293,9 @@ def _pallas_step(v: jax.Array, *, rate: float,
                     pl.when(g)(cp.start)
 
         def wait_fetch(ti, tj, sl):
+            # variants of one piece share a semaphore; their conditions
+            # are mutually exclusive, so exactly the copy that started is
+            # the one waited on
             for cond, cp in copies_for(ti, tj, sl):
                 if cond is None:
                     cp.wait()
@@ -259,26 +347,34 @@ def _pallas_step(v: jax.Array, *, rate: float,
 
         # Divisor correction for ring cells whose true neighbor count is
         # below k: e = rate*v*(1/count - 1/k) is nonzero only on the
-        # outermost grid ring, and its gather reaches one cell further, so
-        # only tiles whose OUTPUT lies within one cell of the ring need
-        # this — a predicate on the tile's cell range, not its grid index
-        # (a ring-adjacent cell can live in a non-edge tile when bh or bw
-        # is 1).
-        near_ring = ((r0 <= 1) | (r0 + bh >= h - 1)
-                     | (c0 <= 1) | (c0 + bw >= w - 1))
+        # outermost GLOBAL grid ring, and its gather reaches one cell
+        # further, so only tiles whose OUTPUT lies within one cell of the
+        # ring need this — a predicate on the tile's global cell range,
+        # not its grid index (a ring-adjacent cell can live in a non-edge
+        # tile when bh or bw is 1, or in any tile of a shard that abuts
+        # the global boundary).
+        if halo:
+            g_r0 = orig_ref[0] + r0
+            g_c0 = orig_ref[1] + c0
+        else:
+            g_r0 = r0
+            g_c0 = c0
+        near_ring = ((g_r0 <= 1) | (g_r0 + bh >= H - 1)
+                     | (g_c0 <= 1) | (g_c0 + bw >= W - 1))
 
         @pl.when(near_ring)
         def _():
-            # one-ring region around the output block, rows [r0-1, r0+bh+1)
+            # one-ring region around the output block, global rows
+            # [g_r0-1, g_r0+bh+1)
             vf2 = win(-1, -1, bh + 2, bw + 2)
-            row_g = (r0 - 1) + lax.broadcasted_iota(
+            row_g = (g_r0 - _i32(1)) + lax.broadcasted_iota(
                 jnp.int32, (bh + 2, bw + 2), 0)
-            col_g = (c0 - 1) + lax.broadcasted_iota(
+            col_g = (g_c0 - _i32(1)) + lax.broadcasted_iota(
                 jnp.int32, (bh + 2, bw + 2), 1)
             cnt = jnp.zeros((bh + 2, bw + 2), jnp.float32)
             for dx, dy in offsets:
-                ok = ((row_g + dx >= 0) & (row_g + dx < h)
-                      & (col_g + dy >= 0) & (col_g + dy < w))
+                ok = ((row_g + _i32(dx) >= 0) & (row_g + _i32(dx) < H)
+                      & (col_g + _i32(dy) >= 0) & (col_g + _i32(dy) < W))
                 cnt = cnt + ok.astype(jnp.float32)
             # off-grid region cells can have cnt 0; vf2 is 0 there anyway
             cnt = jnp.maximum(cnt, 1.0)
@@ -290,15 +386,26 @@ def _pallas_step(v: jax.Array, *, rate: float,
             out_ref[...] = (out_ref[...].astype(jnp.float32)
                             + corr).astype(out_ref.dtype)
 
+    operands = (v,)
+    in_specs = [
+        # pinned to HBM: DMA offsets into HBM are unconstrained, and
+        # ANY would let the compiler pick VMEM for small grids,
+        # re-imposing the (SUB, LANE) slice alignment on the source
+        pl.BlockSpec(memory_space=pltpu.HBM),
+    ]
+    if halo:
+        nslab, sslab, wfull, efull, origin = halo_operands
+        operands = (v, nslab, sslab, wfull, efull, origin)
+        # the SMEM spec needs an EXPLICIT int32 index map: the default
+        # one returns literal zeros, which trace to i64 under
+        # jax_enable_x64 and fail Mosaic verification (func.return i64)
+        in_specs = ([pl.BlockSpec(memory_space=pltpu.HBM)] * 5
+                    + [pl.BlockSpec((2,), lambda i, j: (np.int32(0),),
+                                    memory_space=pltpu.SMEM)])
     return pl.pallas_call(
         kernel,
-        grid=(h // bh, w // bw),
-        in_specs=[
-            # pinned to HBM: DMA offsets into HBM are unconstrained, and
-            # ANY would let the compiler pick VMEM for small grids,
-            # re-imposing the (SUB, LANE) slice alignment on the source
-            pl.BlockSpec(memory_space=pltpu.HBM),
-        ],
+        grid=(gi, gj),
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((bh, bw), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((h, w), v.dtype),
         scratch_shapes=[
@@ -311,7 +418,89 @@ def _pallas_step(v: jax.Array, *, rate: float,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
-    )(v)
+    )(*operands)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("rate", "block", "offsets", "interpret"))
+def _pallas_step(v: jax.Array, *, rate: float,
+                 block: tuple[int, int],
+                 offsets: tuple[tuple[int, int], ...],
+                 interpret: bool) -> jax.Array:
+    return _stencil_call(v, None, rate=rate, block=block, offsets=offsets,
+                         interpret=interpret, global_shape=None)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("rate", "block", "offsets", "interpret",
+                                    "global_shape"))
+def _pallas_halo_step(v, n, s, w_col, e_col, nw, ne, sw, se, origin, *,
+                      rate: float, block: tuple[int, int],
+                      offsets: tuple[tuple[int, int], ...],
+                      interpret: bool,
+                      global_shape: tuple[int, int]) -> jax.Array:
+    """Assemble the raw one-cell ghost ring into piece-granularity slabs
+    and run the halo-mode kernel (see ``_stencil_call``)."""
+    h, w = v.shape
+    bh, bw = block
+    SUB = _sublane(v.dtype)
+    hr = min(SUB, bh)
+    hc = min(LANE, bw)
+    # row slabs [hr, w]: ghost row innermost (adjacent to the interior)
+    nslab = jnp.pad(n, ((hr - 1, 0), (0, 0)))
+    sslab = jnp.pad(s, ((0, hr - 1), (0, 0)))
+    # column slabs [h + 2*hr, hc]: ghost column innermost, hr-row end
+    # caps holding the corner ghost cells
+    wfull = jnp.pad(
+        jnp.concatenate([jnp.pad(nw, ((hr - 1, 0), (0, 0))), w_col,
+                         jnp.pad(sw, ((0, hr - 1), (0, 0)))], axis=0),
+        ((0, 0), (hc - 1, 0)))
+    efull = jnp.pad(
+        jnp.concatenate([jnp.pad(ne, ((hr - 1, 0), (0, 0))), e_col,
+                         jnp.pad(se, ((0, hr - 1), (0, 0)))], axis=0),
+        ((0, 0), (0, hc - 1)))
+    origin = origin.astype(jnp.int32)
+    return _stencil_call(v, (nslab, sslab, wfull, efull, origin),
+                         rate=rate, block=block, offsets=offsets,
+                         interpret=interpret, global_shape=global_shape)
+
+
+def pallas_halo_step(
+    values: jax.Array,
+    ring: dict,
+    origin: jax.Array,
+    global_shape: tuple[int, int],
+    rate: float,
+    offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS,
+    block: Optional[tuple[int, int]] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Per-shard fused flow step consuming a ppermute ghost ring.
+
+    ``ring`` is ``parallel.halo.exchange_ring``'s output: ``n``/``s``
+    ``[1, w]``, ``w``/``e`` ``[h, 1]``, and four ``[1, 1]`` corners —
+    zeros where the shard sits on the true grid boundary (ppermute's
+    zero-fill). ``origin`` is the shard's global (row, col) offset
+    (traced, from ``lax.axis_index``); ``global_shape`` the full grid
+    dims. Semantics: ``pallas_dense_step`` on the global grid, computed
+    shard-locally — the sharded realization of the reference's cross-rank
+    halo update (``/root/reference/src/Model.hpp:189-235``).
+    """
+    offsets = check_offsets(offsets)
+    h, w = values.shape
+    if interpret is None:
+        interpret = resolve_interpret(values)
+    if block is None:
+        sub = _sublane(values.dtype)
+        block = (_pick_block(h, 512, sub), _pick_block(w, 512, LANE))
+    else:
+        block = _validate_block(h, w, block)
+    origin = jnp.asarray(origin, jnp.int32)
+    return _pallas_halo_step(
+        values, ring["n"], ring["s"], ring["w"], ring["e"],
+        ring["nw"], ring["ne"], ring["sw"], ring["se"], origin,
+        rate=float(rate), block=tuple(block), offsets=offsets,
+        interpret=bool(interpret), global_shape=tuple(global_shape))
 
 
 def resolve_interpret(values=None) -> bool:

@@ -102,12 +102,23 @@ class ShardMapExecutor:
     are lifted to dense one-hot fields sharded with the grid. User flows
     needing global coordinates should precompute coordinate fields as extra
     attribute channels.
+
+    ``step_impl`` selects the per-shard field-flow kernel, mirroring
+    ``SerialExecutor``: ``"xla"`` (pad→gather stencil, works for every
+    flow), ``"pallas"`` (the fused halo-mode kernel,
+    ``ops.pallas_stencil.pallas_halo_step``, consuming the ppermute ghost
+    ring — requires every flow to be a plain ``Diffusion``; raises
+    otherwise), or ``"auto"`` (pallas when eligible and its compile
+    succeeds, else xla).
     """
 
-    def __init__(self, mesh: Mesh):
+    def __init__(self, mesh: Mesh, step_impl: str = "xla"):
         if len(mesh.axis_names) not in (1, 2):
             raise ValueError("ShardMapExecutor needs a 1-D or 2-D mesh")
+        if step_impl not in ("xla", "pallas", "auto"):
+            raise ValueError(f"unknown step impl {step_impl!r}")
         self.mesh = mesh
+        self.step_impl = step_impl
         self._cache: dict = {}
 
     @property
@@ -145,19 +156,55 @@ class ShardMapExecutor:
 
     # -- execution ---------------------------------------------------------
 
+    def _pallas_eligible_rates(self, model, space: CellularSpace):
+        """attr→rate map when the fused halo kernel applies (every flow a
+        plain Diffusion, full grid); None → use the XLA path. Raises for
+        an explicit ``step_impl='pallas'`` that can't be honored."""
+        if self.step_impl == "xla":
+            return None
+        rates = model.pallas_rates()
+        has_point = any(isinstance(f, PointFlow) for f in model.flows)
+        ok = rates is not None and not has_point and not space.is_partition
+        if self.step_impl == "pallas" and not ok:
+            raise ValueError(
+                "step_impl='pallas' requires all flows to be plain "
+                "Diffusion on a full (non-partition) grid; got "
+                f"flows={[type(f).__name__ for f in model.flows]}, "
+                f"is_partition={space.is_partition}. Use 'xla' or 'auto'.")
+        return rates if ok else None
+
     def run_model(self, model, space: CellularSpace, num_steps: int) -> Values:
         _check_divisible(space, self.mesh)
         key = (space.shape, space.global_shape, str(space.dtype),
                tuple(space.values), model.offsets, num_steps,
                tuple(f.fingerprint() for f in model.flows))
-        runner = self._cache.get(key)
-        if runner is None:
-            runner = self._build_runner(model, space, num_steps)
-            self._cache[key] = runner
-
         spec = grid_spec(self.mesh)
         sharding = NamedSharding(self.mesh, spec)
         put = partial(jax.device_put, device=sharding)
+        values = {k: put(v) for k, v in space.values.items()}
+
+        entry = self._cache.get(key)
+        if entry is None:
+            rates = self._pallas_eligible_rates(model, space)
+            if rates is not None:
+                prunner = self._build_pallas_runner(model, space, num_steps,
+                                                    rates)
+                # first call traces+compiles; on failure "auto" degrades
+                # to the XLA path (mirrors Model.make_step's fallback)
+                try:
+                    out = prunner(values)
+                except Exception:
+                    if self.step_impl == "pallas":
+                        raise
+                else:
+                    self._cache[key] = ("pallas", prunner)
+                    return out
+            entry = ("xla", self._build_runner(model, space, num_steps))
+            self._cache[key] = entry
+        kind, runner = entry
+        if kind == "pallas":
+            return runner(values)
+
         gdx, gdy = space.global_shape
         counts = put(jnp.asarray(
             neighbor_count_grid(space.dim_x, space.dim_y, model.offsets,
@@ -167,8 +214,54 @@ class ShardMapExecutor:
         const_of, dyn_rate = self._point_flow_fields(model, space)
         const_of = {k: put(v) for k, v in const_of.items()}
         dyn_rate = {k: put(v) for k, v in dyn_rate.items()}
-        values = {k: put(v) for k, v in space.values.items()}
         return runner(values, counts, const_of, dyn_rate)
+
+    def _build_pallas_runner(self, model, space: CellularSpace,
+                             num_steps: int, rates: dict):
+        """Per-shard fused Pallas kernel fed by the ppermute ghost ring —
+        the config-5 architecture (SURVEY §7 'Pallas at 16384²'): the
+        fast kernel and the distributed runtime in one compiled step."""
+        from jax import lax
+
+        from ..ops.pallas_stencil import pallas_halo_step
+        from .halo import exchange_ring
+
+        mesh = self.mesh
+        names = mesh.axis_names
+        ax = names[0]
+        ay = names[1] if len(names) > 1 else None
+        nx = mesh.shape[ax]
+        ny = mesh.shape[ay] if ay else 1
+        local_h = space.dim_x // nx
+        local_w = space.dim_y // ny
+        gshape = (space.dim_x, space.dim_y)
+        offsets = model.offsets
+        spec = grid_spec(mesh)
+
+        def shard_fn(values):
+            row0 = lax.axis_index(ax) * np.int32(local_h)
+            col0 = (lax.axis_index(ay) * np.int32(local_w) if ay
+                    else jnp.int32(0))
+            origin = jnp.stack([row0, col0]).astype(jnp.int32)
+
+            def body(c, _):
+                new = dict(c)
+                for attr, rate in rates.items():
+                    if rate == 0.0:
+                        continue
+                    ring = exchange_ring(c[attr], ax, nx, ay, ny)
+                    new[attr] = pallas_halo_step(
+                        c[attr], ring, origin, gshape, rate, offsets)
+                return new, None
+
+            out, _ = lax.scan(body, values, None, length=num_steps)
+            return out
+
+        # check_vma=False: pallas_call's out_shape carries no
+        # varying-mesh-axes metadata, which the checker would demand
+        sharded = jax.shard_map(shard_fn, mesh=mesh, in_specs=(spec,),
+                                out_specs=spec, check_vma=False)
+        return jax.jit(sharded)
 
     def _build_runner(self, model, space: CellularSpace, num_steps: int):
         mesh = self.mesh

@@ -72,6 +72,40 @@ def pad_with_halo_2d(local: jax.Array, ax_name: str, ay_name: str,
     return jnp.concatenate([top, aug, bottom], axis=0)             # [h+2, w+2]
 
 
+def exchange_ring(local: jax.Array, ax_name: str, nx: int,
+                  ay_name: str = None, ny: int = 1) -> dict:
+    """One-cell ghost ring for a shard as SEPARATE thin arrays (for the
+    Pallas halo kernel, which needs aligned DMA sources, not a
+    concatenated [h+2, w+2] padded copy): ``n``/``s`` [1, w], ``w``/``e``
+    [h, 1], corners [1, 1]. Zeros at true grid edges (ppermute
+    zero-fill / no mesh axis). Corner cells ride the standard two-stage
+    exchange: the column halos are swapped first, then row strips
+    *augmented with those columns' end cells* are swapped, so the
+    diagonal neighbor's corner cell arrives without diagonal permutes."""
+    h, w = local.shape
+    if ay_name is not None and ny > 1:
+        left, right = exchange_halo_1d(local, ay_name, ny, axis=1)
+    else:
+        left = jnp.zeros((h, 1), local.dtype)
+        right = jnp.zeros((h, 1), local.dtype)
+    top_strip = jnp.concatenate(
+        [left[:1], local[:1], right[:1]], axis=1)       # [1, w+2]
+    bot_strip = jnp.concatenate(
+        [left[-1:], local[-1:], right[-1:]], axis=1)
+    if nx > 1:
+        nfull = lax.ppermute(bot_strip, ax_name, _fwd_perm(nx))
+        sfull = lax.ppermute(top_strip, ax_name, _bwd_perm(nx))
+    else:
+        nfull = jnp.zeros_like(top_strip)
+        sfull = jnp.zeros_like(bot_strip)
+    return {
+        "n": nfull[:, 1:w + 1], "s": sfull[:, 1:w + 1],
+        "w": left, "e": right,
+        "nw": nfull[:, 0:1], "ne": nfull[:, w + 1:w + 2],
+        "sw": sfull[:, 0:1], "se": sfull[:, w + 1:w + 2],
+    }
+
+
 def gather_from_padded(padded: jax.Array,
                        offsets: Sequence[tuple[int, int]]) -> jax.Array:
     """inflow[i, j] = Σ_d padded[1+i+dx, 1+j+dy] for an [h+2, w+2] padded

@@ -136,6 +136,79 @@ def test_model_rectangular_default_executor(eight_devices):
     np.testing.assert_allclose(out.to_numpy()["value"], want, atol=1e-12)
 
 
+# -- Pallas × shard_map (the config-5 architecture) ------------------------
+
+def test_shardmap_pallas_1d_matches_oracle(mesh1d):
+    """Fused halo-mode Pallas kernel under a 1-D mesh golden-matches the
+    NumPy oracle (interpret mode on the virtual-CPU mesh)."""
+    from mpi_model_tpu.oracle import dense_flow_step_np
+    space = random_space(40, 24, seed=4, dtype=jnp.float32)
+    want = np.asarray(space.values["value"], np.float64)
+    for _ in range(5):
+        want = dense_flow_step_np(want, 0.13)
+    got = Model(Diffusion(0.13)).execute(
+        space, ShardMapExecutor(mesh1d, step_impl="pallas"), steps=5,
+        check_conservation=False)[0]
+    np.testing.assert_allclose(got.to_numpy()["value"], want,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_shardmap_pallas_2d_matches_oracle(mesh2d):
+    """impl='pallas' under a 2-D mesh (corner ghost cells ride the
+    two-stage exchange into the kernel's window slabs)."""
+    from mpi_model_tpu.oracle import dense_flow_step_np
+    space = random_space(16, 32, seed=5, dtype=jnp.float32)
+    want = np.asarray(space.values["value"], np.float64)
+    for _ in range(4):
+        want = dense_flow_step_np(want, 0.2)
+    got = Model(Diffusion(0.2)).execute(
+        space, ShardMapExecutor(mesh2d, step_impl="pallas"), steps=4,
+        check_conservation=False)[0]
+    np.testing.assert_allclose(got.to_numpy()["value"], want,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_shardmap_pallas_von_neumann(mesh2d):
+    from mpi_model_tpu.core.cell import VON_NEUMANN_OFFSETS
+    from mpi_model_tpu.oracle import dense_flow_step_np
+    space = random_space(16, 32, seed=6, dtype=jnp.float32)
+    want = dense_flow_step_np(
+        np.asarray(space.values["value"], np.float64), 0.1,
+        offsets=VON_NEUMANN_OFFSETS)
+    got = Model(Diffusion(0.1), offsets=VON_NEUMANN_OFFSETS).execute(
+        space, ShardMapExecutor(mesh2d, step_impl="pallas"), steps=1,
+        check_conservation=False)[0]
+    np.testing.assert_allclose(got.to_numpy()["value"], want,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_shardmap_pallas_conservation(mesh2d):
+    space = CellularSpace.create(16, 32, 1.0, dtype=jnp.float32)
+    out, report = Model(Diffusion(0.25), 10.0, 1.0).execute(
+        space, ShardMapExecutor(mesh2d, step_impl="pallas"))
+    assert report.conservation_error() < 1e-2  # f32 rounding only
+
+
+def test_shardmap_pallas_rejects_point_flow(mesh1d):
+    space = CellularSpace.create(40, 24, 1.0, dtype=jnp.float32)
+    model = Model([Diffusion(0.1), PointFlow(source=(9, 3), flow_rate=0.5)])
+    with pytest.raises(ValueError, match="pallas"):
+        model.execute(space, ShardMapExecutor(mesh1d, step_impl="pallas"),
+                      steps=1, check_conservation=False)
+
+
+def test_shardmap_auto_falls_back_with_point_flow(mesh1d):
+    """step_impl='auto' with a point flow silently uses the XLA path and
+    stays correct."""
+    space = CellularSpace.create(40, 24, 1.0, dtype=jnp.float64)
+    flow = PointFlow(source=(9, 3), flow_rate=0.5)
+    want = serial_result(Model(flow), space, 3)
+    got = Model(flow).execute(
+        space, ShardMapExecutor(mesh1d, step_impl="auto"), steps=3,
+        check_conservation=False)[0]
+    np.testing.assert_allclose(got.to_numpy()["value"], want, atol=1e-12)
+
+
 # -- auto-SPMD path --------------------------------------------------------
 
 def test_autosharded_matches_serial(mesh2d):
