@@ -1,0 +1,27 @@
+from .checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+    load_checkpoint,
+    run_checkpointed,
+    save_checkpoint,
+)
+from .output import (
+    merge_dumps,
+    output_filename,
+    partition_dump_lines,
+    write_output,
+    write_partition_dump,
+)
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointManager",
+    "save_checkpoint",
+    "load_checkpoint",
+    "run_checkpointed",
+    "partition_dump_lines",
+    "write_partition_dump",
+    "merge_dumps",
+    "output_filename",
+    "write_output",
+]

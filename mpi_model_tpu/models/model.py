@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import time as _time
+import warnings
 from typing import Callable, Optional, Protocol, Sequence, Union
 
 import jax
@@ -237,7 +238,10 @@ class Model:
                     for s in pallas_steppers.values():
                         jax.block_until_ready(
                             s(jnp.zeros(space.shape, space.dtype)))
-                except Exception:
+                except Exception as e:
+                    warnings.warn(
+                        f"Pallas step failed ({e!r}); impl='auto' falling "
+                        "back to the XLA stencil path", RuntimeWarning)
                     pallas_steppers = None
 
         def step(values: Values) -> Values:

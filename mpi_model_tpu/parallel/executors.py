@@ -29,6 +29,7 @@ needs no special case: its neighbor-share rides the ordinary halo.
 
 from __future__ import annotations
 
+import warnings
 from functools import partial
 from typing import Optional
 
@@ -189,13 +190,19 @@ class ShardMapExecutor:
             if rates is not None:
                 prunner = self._build_pallas_runner(model, space, num_steps,
                                                     rates)
-                # first call traces+compiles; on failure "auto" degrades
-                # to the XLA path (mirrors Model.make_step's fallback)
+                # first call traces+compiles; block_until_ready so
+                # async-dispatched device-side faults surface HERE, not
+                # in the caller after a broken runner got cached. On
+                # failure "auto" degrades to the XLA path (mirrors
+                # Model.make_step's fallback).
                 try:
-                    out = prunner(values)
-                except Exception:
+                    out = jax.block_until_ready(prunner(values))
+                except Exception as e:
                     if self.step_impl == "pallas":
                         raise
+                    warnings.warn(
+                        f"sharded Pallas step failed ({e!r}); falling back "
+                        "to the XLA pad-gather path", RuntimeWarning)
                 else:
                     self._cache[key] = ("pallas", prunner)
                     return out

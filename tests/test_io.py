@@ -1,0 +1,142 @@
+"""Checkpoint/restore + output subsystem (io): roundtrip fidelity,
+resume-equivalence (the VERDICT round-2 item-4 'done' criterion), manager
+pruning, and reference-parity of the partition dump + merge pipeline
+(Model.hpp:100-131, 246-260)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_model_tpu import CellularSpace, Diffusion, Model, PointFlow
+from mpi_model_tpu.io import (
+    CheckpointManager,
+    load_checkpoint,
+    run_checkpointed,
+    save_checkpoint,
+    write_output,
+    write_partition_dump,
+)
+
+RNG = np.random.default_rng(11)
+
+
+def random_space(h, w, dtype=jnp.float64, attrs=("value",)):
+    vals = {a: jnp.asarray(RNG.uniform(0.5, 2.0, (h, w)), dtype=dtype)
+            for a in attrs}
+    return CellularSpace.create(h, w, {a: 1.0 for a in attrs},
+                                dtype=dtype).with_values(vals)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.float32, jnp.bfloat16])
+def test_checkpoint_roundtrip_bit_exact(tmp_path, dtype):
+    space = random_space(12, 17, dtype=dtype, attrs=("a", "b"))
+    path = save_checkpoint(str(tmp_path / "ck.npz"), space, step=7,
+                           extra={"note": "hello"})
+    ck = load_checkpoint(path)
+    assert ck.step == 7
+    assert ck.extra == {"note": "hello"}
+    assert ck.space.shape == space.shape
+    for k in ("a", "b"):
+        got = np.asarray(ck.space.values[k])
+        want = np.asarray(space.values[k])
+        assert got.dtype == want.dtype
+        np.testing.assert_array_equal(
+            got.view(np.uint8), want.view(np.uint8))  # bit-exact
+
+
+def test_checkpoint_preserves_partition_geometry(tmp_path):
+    space = CellularSpace.create(10, 10, 1.0, dtype="float64", x_init=20,
+                                 y_init=30, global_dim_x=100,
+                                 global_dim_y=100)
+    ck = load_checkpoint(save_checkpoint(str(tmp_path / "p.npz"), space))
+    assert (ck.space.x_init, ck.space.y_init) == (20, 30)
+    assert ck.space.global_shape == (100, 100)
+    assert ck.space.is_partition
+
+
+def test_resume_equivalence(tmp_path):
+    """5 steps + checkpoint + restore + 5 steps == 10 straight steps,
+    bit-identical (f64)."""
+    space = random_space(20, 24)
+    model = Model(Diffusion(0.15), 10.0, 1.0)
+
+    straight, _ = model.execute(space, steps=10)
+
+    half, _ = model.execute(space, steps=5)
+    path = save_checkpoint(str(tmp_path / "half.npz"), half, step=5)
+    restored = load_checkpoint(path)
+    assert restored.step == 5
+    resumed, _ = model.execute(restored.space, steps=5)
+
+    np.testing.assert_array_equal(np.asarray(resumed.values["value"]),
+                                  np.asarray(straight.values["value"]))
+
+
+def test_run_checkpointed_resumes_from_latest(tmp_path):
+    space = random_space(16, 16)
+    model = Model(Diffusion(0.1), 10.0, 1.0)
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=2)
+
+    # simulate an interrupted run: first run only 6 of 10 steps
+    out6, step6, _ = run_checkpointed(model, space, mgr, steps=6, every=2)
+    assert step6 == 6
+    assert mgr.steps() == [4, 6]  # pruned to keep=2
+
+    # restart asks for the full 10: resumes at 6, finishes at 10
+    out10, step10, _ = run_checkpointed(model, space, mgr, steps=10, every=2)
+    assert step10 == 10
+    want, _ = model.execute(space, steps=10)
+    np.testing.assert_array_equal(np.asarray(out10.values["value"]),
+                                  np.asarray(want.values["value"]))
+
+    # a stale manager pointing past the request is an error, not silent
+    with pytest.raises(ValueError, match="checkpoint"):
+        run_checkpointed(model, space, mgr, steps=5)
+
+
+def test_partition_dump_format_and_merge(tmp_path):
+    """Reference parity: global x<TAB>y<TAB>value lines per rank, merged
+    file covering every cell exactly once in rank-major order."""
+    space = random_space(8, 6)
+    merged = write_output(str(tmp_path), space, comm_size=4,
+                          fmt="{:.17g}", timestamp="TEST")
+    assert os.path.basename(merged) == "output TEST.txt"
+    # per-rank files exist (comm_rank0..3)
+    for r in range(4):
+        assert os.path.exists(tmp_path / f"comm_rank{r}.txt")
+
+    vals = np.asarray(space.values["value"])
+    seen = {}
+    with open(merged) as f:
+        for line in f:
+            xs, ys, vs = line.rstrip("\n").split("\t")
+            seen[(int(xs), int(ys))] = float(vs)
+    assert len(seen) == 8 * 6
+    for (x, y), v in seen.items():
+        assert v == pytest.approx(float(vals[x, y]), abs=0, rel=0)
+
+
+def test_partition_dump_global_coords(tmp_path):
+    part = CellularSpace.create(3, 4, 2.5, dtype="float64", x_init=10,
+                                y_init=20, global_dim_x=100,
+                                global_dim_y=100)
+    p = write_partition_dump(str(tmp_path), part, rank=2)
+    first = open(p).readline().rstrip("\n").split("\t")
+    assert first == ["10", "20", "2.5"]
+
+
+def test_output_after_model_run_conserves(tmp_path):
+    """End-to-end: run the model, dump, and re-sum the merged file — the
+    conservation contract must survive serialization (17g round-trip)."""
+    space = CellularSpace.create(20, 20, 1.0, dtype="float64")
+    model = Model([Diffusion(0.2), PointFlow(source=(9, 9), flow_rate=0.5)],
+                  5.0, 1.0)
+    out, report = model.execute(space)
+    merged = write_output(str(tmp_path), out, comm_size=4, fmt="{:.17g}")
+    total = 0.0
+    with open(merged) as f:
+        for line in f:
+            total += float(line.split("\t")[2])
+    assert total == pytest.approx(400.0, abs=1e-9)
