@@ -23,9 +23,8 @@ from __future__ import annotations
 
 import datetime as _dt
 import os
+import shutil
 from typing import Iterable, Optional
-
-import numpy as np
 
 from ..core.cellular_space import (
     CellularSpace,
@@ -33,13 +32,14 @@ from ..core.cellular_space import (
     Partition,
     row_partitions,
 )
+from ..parallel.collectives import gather_to_host
 
 
 def partition_dump_lines(space: CellularSpace, attr: str = DEFAULT_ATTR,
                          fmt: str = "{:.6g}") -> Iterable[str]:
     """Row-major ``x<TAB>y<TAB>value`` lines with global coordinates (the
     reference's per-cell dump loop, ``Model.hpp:252-256``)."""
-    vals = np.asarray(space.values[attr])
+    vals = gather_to_host(space.values[attr])
     for lx in range(space.dim_x):
         x = space.x_init + lx
         row = vals[lx]
@@ -64,10 +64,10 @@ def merge_dumps(out_path: str, dump_paths: Iterable[str]) -> str:
     (``Model.hpp:110-131``)."""
     d = os.path.dirname(os.path.abspath(out_path)) or "."
     os.makedirs(d, exist_ok=True)
-    with open(out_path, "w") as out:
+    with open(out_path, "wb") as out:
         for p in dump_paths:
-            with open(p) as f:
-                out.write(f.read())
+            with open(p, "rb") as f:
+                shutil.copyfileobj(f, out)  # streamed: rank dumps can be GBs
     return out_path
 
 
