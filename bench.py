@@ -23,43 +23,9 @@ from __future__ import annotations
 
 import json
 import sys
-import time
 
 
-def _marginal_step_time(step, values, s1: int = 50, s2: int = 250,
-                        reps: int = 2) -> float:
-    import jax
-    import jax.numpy as jnp
-
-    times = {}
-    for steps in (s1, s2):
-        def run_fn(v, _steps=steps):
-            def body(c, _):
-                return step(c), None
-            out, _ = jax.lax.scan(body, v, None, length=_steps)
-            # force real completion through the tunnel: tiny reduction
-            # fetched to host after the scan
-            return out, jnp.sum(
-                jax.tree.leaves(out)[0].astype(jnp.float32))
-        # donated carry buffers (SURVEY §7.6); donation consumes the input,
-        # so each rep runs on a fresh on-device copy made outside the
-        # timed region
-        run = jax.jit(run_fn, donate_argnums=0)
-        fresh = jax.tree.map(jnp.copy, values)
-        out, s = run(fresh)
-        _ = float(s)  # warmup / compile
-        best = float("inf")
-        for _ in range(reps):
-            fresh = jax.tree.map(jnp.copy, values)
-            t0 = time.perf_counter()
-            out, s = run(fresh)
-            _ = float(s)
-            best = min(best, time.perf_counter() - t0)
-        times[steps] = best
-    return (times[s2] - times[s1]) / (s2 - s1)
-
-
-def bench(grid: int = 8192, dtype_name: str = "bfloat16",
+def bench(grid: int = 16384, dtype_name: str = "bfloat16",
           verbose: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
@@ -70,11 +36,13 @@ def bench(grid: int = 8192, dtype_name: str = "bfloat16",
     space = CellularSpace.create(grid, grid, 1.0, dtype=dtype)
     model = Model(Diffusion(0.1), 1.0, 1.0)
 
+    from mpi_model_tpu.utils import marginal_step_time
+
     # "auto" prefers the fused Pallas kernel and falls back to the XLA
     # stencil inside the framework if the kernel fails to compile
     step = model.make_step(space, impl="auto")
     impl_used = step.impl
-    t = _marginal_step_time(step, dict(space.values))
+    t = marginal_step_time(step, dict(space.values))
 
     cups = grid * grid / t
     if verbose:

@@ -1,0 +1,303 @@
+"""The BASELINE config ladder: measured numbers for every config.
+
+The reference publishes no performance numbers (its entire documentation
+is a one-line README), so the baseline is MEASURED here (BASELINE.md):
+for each ladder config this reports cell-updates/sec — defined uniformly
+as ``dim_x * dim_y / step_seconds`` — plus, where the config is sharded,
+the halo-exchange wallclock share, and for configs 1-2 the independent
+baselines (NumPy oracle; the native C++ threads engine).
+
+Configs (BASELINE.md):
+  1. 128^2   Exponencial point flow, serial            [tpu + oracle + native]
+  2. 1024^2  Exponencial, 4-rank row decomposition     [cpu-mesh + oracle + native]
+  3. 4096^2  2-D block decomposition, dense Diffusion  [cpu-mesh halo share; tpu serial]
+  4. 8192^2  multi-attribute (2 coupled flows) f32/bf16 [tpu]
+  5. 16384^2 Moore-8 fused Pallas kernel               [tpu single chip; the
+     multi-host v4-32 config scaled to the hardware this rig has]
+
+Halo share methodology: the sharded step is timed twice on the same mesh
+— halo_mode="exchange" (real ppermute ghost traffic) vs halo_mode="zero"
+(identical compute, zero-filled ghosts, no traffic) — and the share is
+``1 - t_zero / t_exchange``. On this rig the mesh is 8 virtual CPU
+devices (one real TPU chip has no peers), so the share reflects XLA's
+CPU collectives; the methodology carries over to ICI unchanged.
+
+Usage:
+  python -m benchmarks.ladder             # full ladder, one JSON per line
+  python -m benchmarks.ladder --configs 1,3
+  python -m benchmarks.ladder --quick     # tiny shapes (CI smoke)
+  python -m benchmarks.ladder --sweep     # Pallas block-size sweep (config 5)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+# -- independent baselines (configs 1-2) ------------------------------------
+
+def oracle_cups(grid: int, steps: int = 20, point: bool = True) -> float:
+    """NumPy oracle cell-updates/sec on this host's CPU."""
+    import numpy as np
+
+    from mpi_model_tpu import oracle
+
+    v = np.full((grid, grid), 1.0)
+    if point:
+        def step(x):
+            return oracle.point_flow_step_np(x, grid // 2, grid // 2, 0.22)
+    else:
+        def step(x):
+            return oracle.dense_flow_step_np(x, 0.1)
+    step(v)  # warm page-in
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        v = step(v)
+    dt = (time.perf_counter() - t0) / steps
+    return grid * grid / dt
+
+
+def native_cups(grid: int, workers: int = 4) -> float | None:
+    """Native C++ threads engine cell-updates/sec (marginal over steps);
+    None when the driver binary isn't built."""
+    exe = os.path.join(REPO, "native", "build", "mmtpu_main")
+    if not os.path.exists(exe):
+        return None
+
+    from mpi_model_tpu.utils import marginal_runner_time
+
+    def run(steps: int):
+        subprocess.run(
+            [exe, "--backend=threads", f"--dimx={grid}", f"--dimy={grid}",
+             f"--steps={steps}", f"--workers={workers}",
+             "--flow=exponencial", f"--source={grid // 2},{grid // 2}"],
+            check=True, capture_output=True, timeout=600)
+
+    t = marginal_runner_time(run, s1=5, s2=25, reps=2)
+    return grid * grid / t if t > 0 else None
+
+
+# -- framework measurements --------------------------------------------------
+
+def tpu_serial_cups(grid: int, dtype_name: str, flows, impl: str = "auto",
+                    s1: int = 20, s2: int = 100) -> dict:
+    """Serial (single-chip) cell-updates/sec via Model.make_step."""
+    import jax.numpy as jnp
+
+    from mpi_model_tpu import CellularSpace, Model
+    from mpi_model_tpu.utils import marginal_step_time
+
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+             "float64": jnp.float64}[dtype_name]
+    attrs = sorted({f.attr for f in flows})
+    space = CellularSpace.create(grid, grid,
+                                 {a: 1.0 for a in attrs} or 1.0, dtype=dtype)
+    model = Model(list(flows), 1.0, 1.0)
+    step = model.make_step(space, impl=impl)
+    t = marginal_step_time(step, dict(space.values), s1=s1, s2=s2)
+    return {"cups": grid * grid / t, "step_ms": t * 1e3,
+            "impl": getattr(step, "impl", impl)}
+
+
+def sharded_cups_and_halo(grid: int, mesh_shape: tuple, dtype_name: str,
+                          flows, step_impl: str = "xla",
+                          s1: int = 5, s2: int = 25, reps: int = 2) -> dict:
+    """Sharded step on an n-device mesh: cell-updates/sec with real halo
+    exchange, plus the halo wallclock share (see module docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_model_tpu import CellularSpace, Model
+    from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh, make_mesh_2d
+
+    n = 1
+    for m in mesh_shape:
+        n *= m
+    cpus = jax.devices("cpu")
+    if len(cpus) < n:
+        raise RuntimeError(
+            f"need {n} CPU devices; launch with XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n}")
+    if len(mesh_shape) == 1:
+        mesh = make_mesh(mesh_shape[0], devices=cpus[:n])
+    else:
+        mesh = make_mesh_2d(*mesh_shape, devices=cpus[:n])
+
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+             "float64": jnp.float64}[dtype_name]
+    attrs = sorted({f.attr for f in flows})
+    space = CellularSpace.create(grid, grid,
+                                 {a: 1.0 for a in attrs} or 1.0, dtype=dtype)
+
+    with jax.default_device(cpus[0]):
+        times = {}
+        for mode in ("exchange", "zero"):
+            ex = ShardMapExecutor(mesh, step_impl=step_impl, halo_mode=mode)
+            model = Model(list(flows), 1.0, 1.0)
+
+            def run(steps: int):
+                out = ex.run_model(model, space, steps)
+                jax.block_until_ready(out)
+
+            from mpi_model_tpu.utils import marginal_runner_time
+            times[mode] = marginal_runner_time(run, s1=s1, s2=s2, reps=reps)
+
+    t = times["exchange"]
+    if t > 0 and times["zero"] > 0:
+        halo_share = min(1.0, max(0.0, 1.0 - times["zero"] / t))
+    else:
+        halo_share = None  # timing noise (tiny grids): no meaningful share
+    return {"cups": grid * grid / t if t > 0 else None,
+            "step_ms": t * 1e3, "halo_share": halo_share, "devices": n}
+
+
+# -- the ladder --------------------------------------------------------------
+
+def config1(quick: bool = False) -> dict:
+    """128^2 Exponencial, serial — plus oracle + native baselines."""
+    from mpi_model_tpu import Attribute, Cell, Exponencial
+
+    g = 32 if quick else 128
+    flow = Exponencial(Cell(g // 2, g // 2, Attribute(99, 2.2)), 0.1)
+    # tiny grid: steps are ~µs, so the scan lengths must be large enough
+    # for the marginal difference to clear the ~100ms tunnel noise
+    r = tpu_serial_cups(g, "float32", [flow],
+                        s1=200 if quick else 1000,
+                        s2=1000 if quick else 11000)
+    return {
+        "config": 1, "grid": g, "flow": "exponencial", "strategy": "serial",
+        "framework_cups": r["cups"], "framework_impl": r["impl"],
+        "oracle_cups": oracle_cups(g, point=True),
+        "native_threads_cups": None if quick else native_cups(g),
+    }
+
+
+def config2(quick: bool = False) -> dict:
+    """1024^2 Exponencial, 4-rank row decomposition."""
+    from mpi_model_tpu import Attribute, Cell, Exponencial
+
+    g = 64 if quick else 1024
+    # source on a stripe edge: the reference's deliberate halo crosser.
+    # f32 on the mesh rig (real f64 needs jax_enable_x64, which this
+    # harness leaves to the tests); the oracle baseline is true f64.
+    sx = g // 4 - 1
+    flow = Exponencial(Cell(sx, 3, Attribute(99, 2.2)), 0.1)
+    r = sharded_cups_and_halo(g, (4,), "float32", [flow])
+    return {
+        "config": 2, "grid": g, "flow": "exponencial",
+        "strategy": "1-D row stripes x4 (virtual CPU mesh)",
+        "framework_cups": r["cups"], "halo_share": r["halo_share"],
+        "oracle_cups": oracle_cups(g, point=True),
+        "native_threads_cups": None if quick else native_cups(g),
+    }
+
+
+def config3(quick: bool = False) -> dict:
+    """4096^2 dense Diffusion, 2-D block decomposition, corner halo."""
+    from mpi_model_tpu import Diffusion
+
+    g = 64 if quick else 4096
+    r = sharded_cups_and_halo(g, (2, 4), "float32", [Diffusion(0.1)],
+                              s1=10, s2=60, reps=3)
+    serial = tpu_serial_cups(g, "float32", [Diffusion(0.1)],
+                             s1=50, s2=550 if not quick else 250)
+    return {
+        "config": 3, "grid": g, "flow": "diffusion",
+        "strategy": "2-D blocks 2x4 (virtual CPU mesh) + serial TPU",
+        "framework_cups": r["cups"], "halo_share": r["halo_share"],
+        "tpu_serial_cups": serial["cups"], "tpu_impl": serial["impl"],
+    }
+
+
+def config4(quick: bool = False) -> dict:
+    """8192^2 multi-attribute, 2 coupled flows, f32 vs bf16."""
+    from mpi_model_tpu import Coupled, Diffusion
+
+    g = 64 if quick else 8192
+    flows = [Diffusion(0.1, attr="a"),
+             Coupled(flow_rate=0.05, attr="a", modulator="b"),
+             Diffusion(0.2, attr="b")]
+    f32 = tpu_serial_cups(g, "float32", flows, s1=10, s2=50)
+    bf16 = tpu_serial_cups(g, "bfloat16", flows, s1=10, s2=50)
+    return {
+        "config": 4, "grid": g, "flow": "2 coupled + 2 diffusion",
+        "strategy": "serial TPU, multi-attribute",
+        "f32_cups": f32["cups"], "bf16_cups": bf16["cups"],
+        "bf16_speedup": bf16["cups"] / f32["cups"], "impl": f32["impl"],
+    }
+
+
+def config5(quick: bool = False) -> dict:
+    """16384^2 Moore-8 fused Pallas kernel, single chip (v4-32 scaled)."""
+    from mpi_model_tpu import Diffusion
+
+    g = 128 if quick else 16384
+    r = tpu_serial_cups(g, "bfloat16", [Diffusion(0.1)], s1=10, s2=50)
+    return {
+        "config": 5, "grid": g, "flow": "diffusion",
+        "strategy": "fused Pallas, single TPU chip",
+        "framework_cups": r["cups"], "impl": r["impl"],
+        "step_ms": r["step_ms"],
+    }
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+
+
+def sweep_blocks(grid: int = 8192, dtype_name: str = "bfloat16") -> list:
+    """Pallas block-size sweep (promoted from the round-2 scratch file)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_model_tpu.ops.pallas_stencil import pallas_dense_step
+    from mpi_model_tpu.utils import marginal_step_time
+
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
+    v0 = {"value": jnp.ones((grid, grid), dtype=dtype)}
+    results = []
+    for block in [(256, 512), (256, 1024), (512, 512), (512, 1024),
+                  (128, 1024), (256, 2048)]:
+        def step(vals, _b=block):
+            return {"value": pallas_dense_step(vals["value"], 0.1, block=_b,
+                                               interpret=False)}
+        try:
+            t = marginal_step_time(step, v0)
+            results.append({"block": list(block), "step_ms": t * 1e3,
+                            "cups": grid * grid / t})
+        except Exception as e:
+            results.append({"block": list(block), "error": str(e)[:120]})
+    return results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--configs", default="1,2,3,4,5",
+                    help="comma-separated ladder config numbers")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny shapes (smoke test, numbers meaningless)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="run the Pallas block-size sweep instead")
+    args = ap.parse_args(argv)
+
+    if args.sweep:
+        for row in sweep_blocks():
+            print(json.dumps(row))
+        return 0
+
+    for n in [int(x) for x in args.configs.split(",") if x]:
+        row = CONFIGS[n](quick=args.quick)
+        print(json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

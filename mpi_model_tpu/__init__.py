@@ -14,7 +14,7 @@ Layer map (mirrors SURVEY.md §1):
   L3 ``ops``             — Flow/Exponencial + stencil/Pallas kernels
   L4 ``models``          — Model/ModelRectangular (orchestration)
   L5 ``native/`` + CLI   — C++ runtime & driver (Main.cpp)
-  —  ``utils``, ``io``   — config, metrics, checkpoint, output (aux)
+  —  ``utils``, ``io``   — timing/metrics; checkpoint/restore + output
 """
 
 from .abstraction import DataType, get_abstraction_data_type

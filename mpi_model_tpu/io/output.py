@@ -26,6 +26,9 @@ import os
 import shutil
 from typing import Iterable, Optional
 
+import jax
+import numpy as np
+
 from ..core.cellular_space import (
     CellularSpace,
     DEFAULT_ATTR,
@@ -39,7 +42,11 @@ def partition_dump_lines(space: CellularSpace, attr: str = DEFAULT_ATTR,
                          fmt: str = "{:.6g}") -> Iterable[str]:
     """Row-major ``x<TAB>y<TAB>value`` lines with global coordinates (the
     reference's per-cell dump loop, ``Model.hpp:252-256``)."""
-    vals = gather_to_host(space.values[attr])
+    # Per-RANK dump: the space here is host-local (a partition slice, or a
+    # single-process grid) — a plain device_get, NOT the cross-process
+    # gather (which would concatenate every rank's data and corrupt the
+    # per-rank files). write_output performs the global gather once.
+    vals = np.asarray(jax.device_get(space.values[attr]))
     for lx in range(space.dim_x):
         x = space.x_init + lx
         row = vals[lx]
@@ -92,9 +99,12 @@ def write_output(directory: str, space: CellularSpace,
     """
     if partitions is None:
         partitions = row_partitions(space.dim_x, space.dim_y, comm_size)
+    # one global gather (multi-host safe), then host-side partition slices
+    host_space = space.with_values(
+        {k: gather_to_host(v) for k, v in space.values.items()})
     dumps = [
-        write_partition_dump(directory, space.slice_partition(p), p.rank,
-                             attr, fmt)
+        write_partition_dump(directory, host_space.slice_partition(p),
+                             p.rank, attr, fmt)
         for p in partitions
     ]
     return merge_dumps(

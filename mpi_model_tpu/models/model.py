@@ -36,7 +36,7 @@ import numpy as np
 from ..core.cell import MOORE_OFFSETS
 from ..core.cellular_space import CellularSpace
 from ..ops.flow import Flow, PointFlow, build_outflow
-from ..ops.stencil import point_flow_step, transport
+from ..ops.stencil import neighbor_counts_traced, point_flow_step, transport
 
 Values = dict[str, jax.Array]
 
@@ -193,7 +193,6 @@ class Model:
         if cached is not None:
             return cached
 
-        counts = space.neighbor_counts(self.offsets)
         offsets = self.offsets
         origin = (space.x_init, space.y_init)
         point_flows = [f for f in self.flows if isinstance(f, PointFlow)]
@@ -244,8 +243,16 @@ class Model:
                         "back to the XLA stencil path", RuntimeWarning)
                     pallas_steppers = None
 
+        gshape = space.global_shape
+        shape = (space.dim_x, space.dim_y)
+
         def step(values: Values) -> Values:
             new = dict(values)
+            # counts as traced iota arithmetic INSIDE the step: closing
+            # over the materialized numpy grid bakes an O(grid) constant
+            # into the compiled program (256MB at 8192² f32)
+            counts = neighbor_counts_traced(shape, offsets, origin, gshape,
+                                            space.dtype)
             if pallas_steppers is not None:
                 for attr, stepper in pallas_steppers.items():
                     new[attr] = stepper(values[attr])

@@ -57,6 +57,36 @@ def gather_neighbors(share: jax.Array,
     return inflow
 
 
+def neighbor_counts_traced(
+    shape: tuple[int, int],
+    offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS,
+    origin: tuple[int, int] = (0, 0),
+    global_shape: tuple[int, int] = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Per-cell in-bounds neighbor counts as TRACED iota arithmetic.
+
+    The numpy twin (``core.cell.neighbor_count_grid``) materializes a
+    concrete array — closing a jitted step over that bakes an O(grid)
+    constant into the compiled program (256MB at 8192² f32, which also
+    overflows remote-compile transports). Recomputing from iotas inside
+    the step is a handful of VPU compares per cell — cheaper than the
+    HBM read of a materialized counts array in a bandwidth-bound step.
+    """
+    h, w = shape
+    gx, gy = global_shape if global_shape is not None else (h, w)
+    x0, y0 = origin
+    rows = x0 + jnp.arange(h, dtype=jnp.int32)[:, None]
+    cols = y0 + jnp.arange(w, dtype=jnp.int32)[None, :]
+    cnt = None
+    for dx, dy in offsets:
+        ok = ((rows + dx >= 0) & (rows + dx < gx)
+              & (cols + dy >= 0) & (cols + dy < gy))
+        c = ok.astype(dtype)
+        cnt = c if cnt is None else cnt + c
+    return cnt
+
+
 def transport(values: jax.Array, outflow: jax.Array, counts: jax.Array,
               offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS) -> jax.Array:
     """One mass-conserving redistribution step over the whole grid."""

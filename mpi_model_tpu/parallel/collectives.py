@@ -35,5 +35,9 @@ def global_sum(local: jax.Array, axis_names) -> jax.Array:
 
 def gather_to_host(x: jax.Array) -> np.ndarray:
     """Fetch a (possibly sharded) global array to host memory — the typed
-    equivalent of the reference's per-rank file merge (``Model.hpp:110-131``)."""
+    equivalent of the reference's per-rank file merge (``Model.hpp:110-131``).
+    Cross-host shardings route through the multi-host gather."""
+    if isinstance(x, jax.Array) and jax.process_count() > 1:
+        from .multihost import gather_global
+        return gather_global(x)
     return np.asarray(jax.device_get(x))

@@ -38,11 +38,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..core.cell import neighbor_count_grid
 from ..core.cellular_space import CellularSpace
 from ..ops.flow import PointFlow
 from .halo import gather_from_padded, pad_with_halo_1d, pad_with_halo_2d
-from .mesh import grid_spec
+from .mesh import grid_spec, put_global
 
 Values = dict[str, jax.Array]
 
@@ -90,7 +89,7 @@ class AutoShardedExecutor:
 
             runner = jax.jit(_run)
             self._cache[key] = runner
-        values = {k: jax.device_put(v, NamedSharding(self.mesh, self.spec))
+        values = {k: put_global(v, NamedSharding(self.mesh, self.spec))
                   for k, v in space.values.items()}
         return runner(values)
 
@@ -116,13 +115,22 @@ class ShardMapExecutor:
     succeeds, else xla).
     """
 
-    def __init__(self, mesh: Mesh, step_impl: str = "xla"):
+    def __init__(self, mesh: Mesh, step_impl: str = "xla",
+                 halo_mode: str = "exchange"):
         if len(mesh.axis_names) not in (1, 2):
             raise ValueError("ShardMapExecutor needs a 1-D or 2-D mesh")
         if step_impl not in ("xla", "pallas", "auto"):
             raise ValueError(f"unknown step impl {step_impl!r}")
+        if halo_mode not in ("exchange", "zero"):
+            raise ValueError(f"unknown halo mode {halo_mode!r}")
         self.mesh = mesh
         self.step_impl = step_impl
+        #: DIAGNOSTIC knob for measuring halo cost (benchmarks/ladder.py's
+        #: halo-exchange wallclock share): "zero" replaces every ppermute
+        #: ghost exchange with zero padding — identical compute shape, NO
+        #: inter-shard traffic, WRONG results at shard boundaries. Never
+        #: use for real runs.
+        self.halo_mode = halo_mode
         self._cache: dict = {}
 
     @property
@@ -184,7 +192,7 @@ class ShardMapExecutor:
                tuple(f.fingerprint() for f in model.flows))
         spec = grid_spec(self.mesh)
         sharding = NamedSharding(self.mesh, spec)
-        put = partial(jax.device_put, device=sharding)
+        put = partial(put_global, sharding=sharding)
         values = {k: put(v) for k, v in space.values.items()}
 
         entry = self._cache.get(key)
@@ -215,16 +223,10 @@ class ShardMapExecutor:
         if kind == "pallas":
             return runner(values)
 
-        gdx, gdy = space.global_shape
-        counts = put(jnp.asarray(
-            neighbor_count_grid(space.dim_x, space.dim_y, model.offsets,
-                                x_init=space.x_init, y_init=space.y_init,
-                                global_dim_x=gdx, global_dim_y=gdy),
-            dtype=space.dtype))
         const_of, dyn_rate = self._point_flow_fields(model, space)
         const_of = {k: put(v) for k, v in const_of.items()}
         dyn_rate = {k: put(v) for k, v in dyn_rate.items()}
-        return runner(values, counts, const_of, dyn_rate)
+        return runner(values, const_of, dyn_rate)
 
     def _build_pallas_runner(self, model, space: CellularSpace,
                              num_steps: int, rates: dict):
@@ -234,7 +236,7 @@ class ShardMapExecutor:
         from jax import lax
 
         from ..ops.pallas_stencil import pallas_halo_step
-        from .halo import exchange_ring
+        from .halo import exchange_ring, zero_ring
 
         mesh = self.mesh
         names = mesh.axis_names
@@ -259,7 +261,8 @@ class ShardMapExecutor:
                 for attr, rate in rates.items():
                     if rate == 0.0:
                         continue
-                    ring = exchange_ring(c[attr], ax, nx, ay, ny)
+                    ring = (zero_ring(c[attr]) if self.halo_mode == "zero"
+                            else exchange_ring(c[attr], ax, nx, ay, ny))
                     new[attr] = pallas_halo_step(
                         c[attr], ring, origin, gshape, rate, offsets)
                 return new, None
@@ -302,13 +305,23 @@ class ShardMapExecutor:
         local_h = space.dim_x // nx
         local_w = space.dim_y // ny
 
-        if len(names) == 1:
+        if self.halo_mode == "zero":
+            def pad(z):  # diagnostic: no inter-shard traffic (see __init__)
+                return jnp.pad(z, 1)
+        elif len(names) == 1:
             def pad(z):
                 return pad_with_halo_1d(z, names[0], axis_sizes[0])
         else:
             def pad(z):
                 return pad_with_halo_2d(z, names[0], names[1],
                                         axis_sizes[0], axis_sizes[1])
+
+        # global bounds / origin: the sharded space may itself be a
+        # partition of a larger grid — boundary topology follows the TRUE
+        # grid edges, exactly like the numpy counts did
+        gshape = space.global_shape
+        x_init, y_init = space.x_init, space.y_init
+        dtype = space.dtype
 
         def local_step(values, counts, const_of, dyn_rate, origin):
             new = dict(values)
@@ -333,12 +346,18 @@ class ShardMapExecutor:
                 new[attr] = values[attr] - outflow + inflow
             return new
 
-        def shard_fn(values, counts, const_of, dyn_rate):
+        def shard_fn(values, const_of, dyn_rate):
             from jax import lax
-            row0 = lax.axis_index(names[0]) * np.int32(local_h)
-            col0 = (lax.axis_index(names[1]) * np.int32(local_w)
-                    if len(names) > 1 else jnp.int32(0))
+
+            from ..ops.stencil import neighbor_counts_traced
+            row0 = np.int32(x_init) + lax.axis_index(names[0]) * np.int32(local_h)
+            col0 = (np.int32(y_init) + lax.axis_index(names[1]) * np.int32(local_w)
+                    if len(names) > 1 else jnp.int32(y_init))
             origin = (row0, col0)
+            # per-shard counts as traced iota arithmetic — no O(grid)
+            # host array, no extra sharded operand (mirrors make_step)
+            counts = neighbor_counts_traced((local_h, local_w), offsets,
+                                            origin, gshape, dtype)
 
             def body(c, _):
                 return local_step(c, counts, const_of, dyn_rate, origin), None
@@ -347,6 +366,6 @@ class ShardMapExecutor:
 
         sharded = jax.shard_map(
             shard_fn, mesh=mesh,
-            in_specs=(spec, spec, spec, spec),
+            in_specs=(spec, spec, spec),
             out_specs=spec)
         return jax.jit(sharded)

@@ -106,6 +106,20 @@ def exchange_ring(local: jax.Array, ax_name: str, nx: int,
     }
 
 
+def zero_ring(local: jax.Array) -> dict:
+    """An all-zero ghost ring shaped like ``exchange_ring``'s output —
+    the no-traffic stand-in used when measuring halo cost (and the
+    boundary condition of a standalone full grid)."""
+    h, w = local.shape
+
+    def z(s):
+        return jnp.zeros(s, local.dtype)
+
+    return {"n": z((1, w)), "s": z((1, w)), "w": z((h, 1)), "e": z((h, 1)),
+            "nw": z((1, 1)), "ne": z((1, 1)), "sw": z((1, 1)),
+            "se": z((1, 1))}
+
+
 def gather_from_padded(padded: jax.Array,
                        offsets: Sequence[tuple[int, int]]) -> jax.Array:
     """inflow[i, j] = Σ_d padded[1+i+dx, 1+j+dy] for an [h+2, w+2] padded

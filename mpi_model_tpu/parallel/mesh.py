@@ -81,6 +81,32 @@ def grid_spec(mesh: Mesh) -> P:
     return P(names[0], names[1] if len(names) > 1 else None)
 
 
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh includes devices owned by other processes
+    (multi-host: a 'rank' is a process, SURVEY §5)."""
+    pidx = jax.process_index()
+    return any(d.process_index != pidx for d in mesh.devices.flat)
+
+
+def put_global(value, sharding) -> jax.Array:
+    """``device_put`` that also works when the sharding spans other
+    processes' devices: each process supplies its addressable shards from
+    its (identical) host copy — the multi-host scatter. Single-process
+    shardings take the plain device_put path; values ALREADY sharded
+    across processes are kept on-device (resharded via a compiled
+    identity when the layout differs) instead of a crashing device_get."""
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is None or isinstance(mesh, Mesh) and not mesh_spans_processes(mesh):
+        return jax.device_put(value, sharding)
+    if isinstance(value, jax.Array) and not value.is_fully_addressable:
+        if value.sharding == sharding:
+            return value
+        return jax.jit(lambda x: x, out_shardings=sharding)(value)
+    npv = np.asarray(jax.device_get(value))
+    return jax.make_array_from_callback(npv.shape, sharding,
+                                        lambda idx: npv[idx])
+
+
 def shard_space(space: CellularSpace, mesh: Mesh,
                 spec: Optional[P] = None) -> CellularSpace:
     """Place the space's channels onto the mesh (the live ``Scatter``).
@@ -91,5 +117,5 @@ def shard_space(space: CellularSpace, mesh: Mesh,
     """
     spec = grid_spec(mesh) if spec is None else spec
     sharding = NamedSharding(mesh, spec)
-    vals = {k: jax.device_put(v, sharding) for k, v in space.values.items()}
+    vals = {k: put_global(v, sharding) for k, v in space.values.items()}
     return space.with_values(vals)

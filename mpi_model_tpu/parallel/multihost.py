@@ -1,0 +1,186 @@
+"""Multi-host scaffolding: the DCN control plane (BASELINE config 5).
+
+The reference scales across nodes with ``mpirun`` + per-rank
+``MPI_Init``/``MPI_Comm_rank`` (``/root/reference/src/Main.cpp:21-23``)
+and funnels every result through the master rank. TPU-native equivalent
+(SURVEY §5 "distributed communication backend"): one Python process per
+host, linked by ``jax.distributed`` — after ``initialize()`` every
+process sees the GLOBAL device set, a ``Mesh`` spans hosts, ``shard_map``
+collectives ride ICI within a slice and DCN across slices, and process 0
+plays the master for host-side gather/report/output.
+
+Testable without hardware: two local processes with virtual CPU devices
+form a real 2-process jax.distributed cluster (``dryrun_two_process``,
+exercised by tests/test_multihost.py).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+import numpy as np
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids=None) -> None:
+    """Join (or form) the multi-process cluster.
+
+    Thin wrapper over ``jax.distributed.initialize`` that is a NO-OP when
+    the cluster is already initialized or when nothing indicates a
+    multi-process launch (no args, no ``JAX_COORDINATOR_ADDRESS`` /
+    TPU-pod metadata) — so single-host runs can call it unconditionally,
+    the way the reference always calls ``MPI_Init``.
+    """
+    import jax
+
+    try:
+        already = jax.distributed.is_initialized()
+    except AttributeError:  # very old jax without the public probe
+        already = getattr(jax._src.distributed.global_state, "client",
+                          None) is not None
+    if already:
+        return
+    env_coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None and env_coord is None \
+            and num_processes is None:
+        return  # single-process run
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address or env_coord,
+        num_processes=num_processes, process_id=process_id,
+        local_device_ids=local_device_ids)
+
+
+def process_index() -> int:
+    """This process's rank (the reference's ``comm_rank``)."""
+    import jax
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def is_master() -> bool:
+    """Process 0 — the reference's MASTER rank (``Defines.hpp:10``)."""
+    return process_index() == 0
+
+
+def host_local_to_global(local_np, mesh, spec):
+    """Assemble per-host shards into one global sharded array (the typed
+    replacement for the reference's descriptor-scatter, ``Model.hpp:62-76``)."""
+    from jax.experimental import multihost_utils
+    return multihost_utils.host_local_array_to_global_array(
+        local_np, mesh, spec)
+
+
+def gather_global(x) -> np.ndarray:
+    """Fetch a (possibly cross-host sharded) array to every host as
+    numpy — the master-side merge (``Model.hpp:110-131``). For
+    single-process runs this is a plain device_get."""
+    import jax
+    if jax.process_count() == 1:
+        return np.asarray(jax.device_get(x))
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+    mesh = getattr(getattr(x, "sharding", None), "mesh", None)
+    if mesh is not None and getattr(mesh, "devices", None) is not None:
+        # global sharded array → fully-replicated host-local copy
+        return np.asarray(multihost_utils.global_array_to_host_local_array(
+            x, mesh, P(*([None] * x.ndim))))
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def sync(name: str = "barrier") -> None:
+    """Cross-process barrier (no-op single-process)."""
+    import jax
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)
+
+
+# -- two-local-process CPU dryrun (the hardware-free config-5 rig) -----------
+
+_WORKER = r"""
+import sys
+sys.path.insert(0, {root!r})
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize overrides the env var
+from mpi_model_tpu.parallel import multihost
+multihost.initialize("127.0.0.1:{port}", num_processes=2,
+                     process_id={pid})
+import numpy as np
+from jax.sharding import Mesh
+from mpi_model_tpu import CellularSpace, Diffusion, Model, PointFlow
+from mpi_model_tpu.parallel import ShardMapExecutor
+from mpi_model_tpu.parallel.collectives import gather_to_host
+
+assert jax.process_count() == 2, jax.process_count()
+devs = jax.devices()
+assert len(devs) == 8, devs  # 4 virtual CPU devices per process
+mesh = Mesh(np.array(devs).reshape(2, 4), ("x", "y"))
+
+h, w = 16, 32
+space = CellularSpace.create(h, w, 1.0, dtype="float32")
+# a point source on a block edge: its share crosses a process boundary
+model = Model([Diffusion(0.2), PointFlow(source=(7, 15), flow_rate=0.5)],
+              3.0, 1.0)
+# the REAL product path: Model.execute with its conservation contract,
+# over a mesh spanning both processes (SPMD: identical program each rank)
+out, report = model.execute(space, ShardMapExecutor(mesh))
+assert report.comm_size == 8, report
+full = gather_to_host(out.values["value"])
+assert full.shape == (h, w)
+assert np.isfinite(full).all()
+multihost.sync("after-run")
+if multihost.is_master():
+    # master-side conservation report (Model.hpp:88-95)
+    print(f"MASTER ok: procs={{jax.process_count()}} "
+          f"total={{float(full.sum())}} "
+          f"conservation_err={{report.conservation_error():.3e}}", flush=True)
+else:
+    print(f"worker {{multihost.process_index()}} done", flush=True)
+"""
+
+
+def dryrun_two_process(port: Optional[int] = None, timeout: int = 300) -> str:
+    """Launch a real 2-process jax.distributed cluster on this host (4
+    virtual CPU devices each → one 2x4 global mesh), run a sharded step
+    spanning both processes, and return the master's report line."""
+    if port is None:
+        port = 29500 + os.getpid() % 400  # avoid collisions between runs
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    procs = []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env.pop("JAX_PLATFORMS", None)
+        code = _WORKER.format(root=root, port=port, pid=pid)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for rc, out, err in outs:
+        if rc != 0:
+            raise RuntimeError(
+                f"multihost dryrun worker failed (rc={rc}):\n"
+                f"{out[-2000:]}\n{err[-2000:]}")
+    master_out = outs[0][1]
+    if "MASTER ok" not in master_out:
+        raise RuntimeError(f"no master report in: {master_out!r}")
+    return master_out.strip().splitlines()[-1]

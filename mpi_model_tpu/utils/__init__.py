@@ -1,0 +1,3 @@
+from .metrics import marginal_runner_time, marginal_step_time
+
+__all__ = ["marginal_step_time", "marginal_runner_time"]

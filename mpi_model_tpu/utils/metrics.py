@@ -1,0 +1,71 @@
+"""Timing/metrics helpers shared by bench.py and benchmarks/ladder.py.
+
+The reference never measures its own speed (SURVEY §5: no timers
+anywhere), so the framework carries its own instrumentation. The core
+primitive is MARGINAL step timing: the remote-TPU tunnel adds ~100ms of
+fixed dispatch overhead per call, so per-step cost is measured as
+``(t(s2) - t(s1)) / (s2 - s1)`` between two scan lengths, with completion
+forced by an on-device reduction fetched to host (``block_until_ready``
+alone does not block through the tunnel).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+Values = dict
+
+
+def marginal_step_time(step: Callable, values: Values, s1: int = 50,
+                       s2: int = 250, reps: int = 2,
+                       donate: bool = True) -> float:
+    """Seconds per step of ``step`` (a Values→Values function), measured
+    marginally between scan lengths ``s1`` and ``s2`` with donated carry
+    buffers (SURVEY §7.6) and best-of-``reps`` timing."""
+    import jax
+    import jax.numpy as jnp
+
+    times = {}
+    for steps in (s1, s2):
+        def run_fn(v, _steps=steps):
+            def body(c, _):
+                return step(c), None
+            out, _ = jax.lax.scan(body, v, None, length=_steps)
+            # force real completion through the tunnel: tiny reduction
+            # fetched to host after the scan
+            return out, jnp.sum(
+                jax.tree.leaves(out)[0].astype(jnp.float32))
+        # donation consumes the input, so each rep runs on a fresh
+        # on-device copy made outside the timed region
+        run = jax.jit(run_fn, donate_argnums=0 if donate else ())
+        fresh = jax.tree.map(jnp.copy, values)
+        out, s = run(fresh)
+        _ = float(s)  # warmup / compile
+        best = float("inf")
+        for _ in range(reps):
+            fresh = jax.tree.map(jnp.copy, values)
+            t0 = time.perf_counter()
+            out, s = run(fresh)
+            _ = float(s)
+            best = min(best, time.perf_counter() - t0)
+        times[steps] = best
+    return (times[s2] - times[s1]) / (s2 - s1)
+
+
+def marginal_runner_time(make_output: Callable[[int], object],
+                         s1: int = 10, s2: int = 50,
+                         reps: int = 2) -> float:
+    """Marginal per-step seconds for an arbitrary runner: calls
+    ``make_output(num_steps)`` (which must block until the work is truly
+    done and may be a subprocess run) at two step counts."""
+    times = {}
+    for steps in (s1, s2):
+        make_output(steps)  # warmup / compile / page-in
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            make_output(steps)
+            best = min(best, time.perf_counter() - t0)
+        times[steps] = best
+    return (times[s2] - times[s1]) / (s2 - s1)

@@ -1,0 +1,31 @@
+"""Smoke tests for the benchmark harness (benchmarks/ladder.py): the
+ladder functions run end-to-end at tiny scale and produce well-formed
+rows. Numbers in quick mode are meaningless by design — only structure
+and sign are asserted."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmarks.ladder import config1, config2, oracle_cups  # noqa: E402
+
+
+def test_oracle_cups_positive():
+    assert oracle_cups(64, steps=3, point=True) > 0
+    assert oracle_cups(64, steps=3, point=False) > 0
+
+
+def test_ladder_config1_quick():
+    row = config1(quick=True)
+    assert row["config"] == 1
+    assert row["oracle_cups"] > 0
+    assert row["framework_impl"] in ("xla", "pallas")
+    assert row["native_threads_cups"] is None  # skipped in quick mode
+
+
+def test_ladder_config2_quick():
+    row = config2(quick=True)
+    assert row["config"] == 2
+    assert "halo_share" in row
+    assert row["strategy"].startswith("1-D row stripes")

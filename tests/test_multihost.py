@@ -1,0 +1,33 @@
+"""Multi-host scaffolding: a REAL two-process jax.distributed cluster on
+this host (4 virtual CPU devices per process → one 2x4 global mesh), the
+full Model.execute product path spanning both processes, process-0
+gather/report (round-2 VERDICT item 7)."""
+
+import pytest
+
+from mpi_model_tpu.parallel import multihost
+
+
+def test_initialize_noop_single_process():
+    # no coordinator configured → must not try to form a cluster
+    multihost.initialize()
+    assert multihost.process_count() == 1
+    assert multihost.is_master()
+
+
+def test_gather_global_single_process():
+    import jax.numpy as jnp
+    import numpy as np
+    x = jnp.arange(12.0).reshape(3, 4)
+    got = multihost.gather_global(x)
+    np.testing.assert_array_equal(got, np.arange(12.0).reshape(3, 4))
+
+
+@pytest.mark.slow
+def test_two_process_cpu_dryrun():
+    """Spawns two linked processes; the sharded step runs over a mesh
+    spanning both, a point flow crosses the process boundary, and the
+    master reports conservation."""
+    line = multihost.dryrun_two_process(port=29791)
+    assert "MASTER ok: procs=2" in line
+    assert "conservation_err=0.000e+00" in line
