@@ -63,6 +63,11 @@ class Report:
     #: for dynamic flows it trails it by one step.
     last_execute: list[float]
     wall_time_s: float
+    #: the executing backend's OWN report, when it produces one (the
+    #: native C++ engine's totals/conservation numbers — kept instead of
+    #: discarded so cross-backend drift is visible); None for pure-JAX
+    #: executors, whose report IS this Report.
+    backend_report: Optional[dict] = None
 
     def conservation_error(self) -> float:
         return max(
@@ -342,12 +347,16 @@ class Model:
 
         report = Report(
             comm_size=getattr(executor, "comm_size", 1),
-            rank_id=0,
+            # this process's rank in the cluster — the reference's
+            # comm_rank (Main.cpp:23); 0 single-process, the true
+            # process index under jax.distributed (multihost)
+            rank_id=jax.process_index(),
             steps=num_steps,
             initial_total=initial,
             final_total=final,
             last_execute=last_exec,
             wall_time_s=wall,
+            backend_report=getattr(executor, "last_backend_report", None),
         )
         if check_conservation and not space.is_partition:
             thresh = self.conservation_threshold(space, tolerance, rtol,

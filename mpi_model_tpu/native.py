@@ -183,6 +183,10 @@ class NativeExecutor:
     def __init__(self, lines: int = 1, columns: int = 1):
         self.lines = lines
         self.columns = columns
+        #: the native engine's own report from the last run (initial/final
+        #: totals and conservation error computed IN C++) — surfaced on
+        #: Report.backend_report by Model.execute instead of discarded
+        self.last_backend_report: Optional[dict] = None
 
     @property
     def comm_size(self) -> int:
@@ -196,8 +200,10 @@ class NativeExecutor:
         for attr in space.values:
             np.copyto(ns.channel(attr),
                       np.asarray(space.values[attr], dtype=np.float64))
-        ns.run(model.flows, num_steps, self.lines, self.columns,
-               check_conservation=False)
+        self.last_backend_report = ns.run(
+            model.flows, num_steps, self.lines, self.columns,
+            check_conservation=False)
+        self.last_backend_report["engine"] = "native-c++"
         return {attr: jnp.asarray(ns.channel(attr).copy(),
                                   dtype=space.dtype)
                 for attr in space.values}

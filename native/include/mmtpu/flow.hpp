@@ -50,16 +50,23 @@ class Flow {
   const std::string& attr() const { return attr_; }
   double flow_rate() const { return flow_rate_; }
   double last_execute() const { return last_execute_; }
+  // Memo setter for the ORCHESTRATOR (Model), which owns when/how per-rank
+  // amounts combine into the Flow::last_execute memo (Flow.hpp:14,57).
+  void set_last_execute(double v) { last_execute_ = v; }
 
   // Fill `out` (same layout as the space's channels) with this flow's
-  // outflow for the current values; returns total amount (execute() memo,
-  // Flow.hpp:14,57).
+  // outflow for the current values; returns the amount moved. const —
+  // in threaded runs every rank invokes the SAME shared Flow object
+  // concurrently on its partition, so the op must not touch shared
+  // state (a TSan-caught race when the memo write lived here).
   virtual double add_outflow(const CellularSpace& cs,
-                             std::vector<double>& out) = 0;
+                             std::vector<double>& out) const = 0;
 
  protected:
   std::string attr_;
   double flow_rate_;
+
+ private:
   double last_execute_ = 0.0;
 };
 
@@ -77,14 +84,13 @@ class PointFlow : public Flow {
                   cell.attribute.value) {}
 
   double add_outflow(const CellularSpace& cs,
-                     std::vector<double>& out) override {
+                     std::vector<double>& out) const override {
     Partition p{cs.x_init(), cs.y_init(), cs.dim_x(), cs.dim_y(), 0};
     if (!p.contains(x_, y_)) return 0.0;  // owner test, Model.hpp:176
     size_t idx = cs.local_index(x_, y_);
     double v = frozen_ ? *frozen_ : cs.channel(attr_)[idx];
     double amount = flow_rate_ * v;
     out[idx] += amount;
-    last_execute_ = amount;
     return amount;
   }
 
@@ -112,7 +118,7 @@ class Diffusion : public Flow {
       : Flow(std::move(attr), rate) {}
 
   double add_outflow(const CellularSpace& cs,
-                     std::vector<double>& out) override {
+                     std::vector<double>& out) const override {
     const auto& v = cs.channel(attr_);
     double total = 0.0;
     for (size_t i = 0; i < v.size(); ++i) {
@@ -120,7 +126,6 @@ class Diffusion : public Flow {
       out[i] += o;
       total += o;
     }
-    last_execute_ = total;
     return total;
   }
 };
@@ -132,7 +137,7 @@ class Coupled : public Flow {
       : Flow(std::move(attr), rate), modulator_(std::move(modulator)) {}
 
   double add_outflow(const CellularSpace& cs,
-                     std::vector<double>& out) override {
+                     std::vector<double>& out) const override {
     const auto& v = cs.channel(attr_);
     const auto& m = cs.channel(modulator_);
     double total = 0.0;
@@ -141,7 +146,6 @@ class Coupled : public Flow {
       out[i] += o;
       total += o;
     }
-    last_execute_ = total;
     return total;
   }
 

@@ -16,6 +16,7 @@
 
 #include <cmath>
 #include <functional>
+#include <map>
 #include <memory>
 #include <stdexcept>
 #include <string>
@@ -59,18 +60,25 @@ class Model {
 
   const std::vector<FlowPtr>& flows() const { return flows_; }
 
-  // One step on one partition, ghost ring provided by `fill_ghosts` (serial:
-  // leave zeros). Outflows are computed per attribute from pre-step values.
+  // One step on one partition, ghost ring provided by `fill_ghosts`
+  // (serial: leave zeros). Outflows are computed per attribute from
+  // pre-step values. `amounts`, when given, receives each flow's amount
+  // on THIS partition (aligned with flows()) — the per-rank share of the
+  // Flow::last_execute memo, which the orchestrator combines after the
+  // step (workers must not write shared Flow state; TSan-verified).
   void step_partition(
       CellularSpace& cs, const std::vector<double>& counts,
       const std::function<void(const std::string&, std::vector<double>&)>&
-          fill_ghosts = {}) const {
+          fill_ghosts = {},
+      std::vector<double>* amounts = nullptr) const {
     // group outflows by attribute
     std::map<std::string, std::vector<double>> outflows;
-    for (const auto& f : flows_) {
+    for (size_t fi = 0; fi < flows_.size(); ++fi) {
+      const auto& f = flows_[fi];
       auto& of = outflows[f->attr()];
       if (of.empty()) of.assign(cs.num_cells(), 0.0);
-      f->add_outflow(cs, of);
+      double amt = f->add_outflow(cs, of);
+      if (amounts) (*amounts)[fi] = amt;
     }
     for (auto& [attr, of] : outflows) {
       auto padded = padded_share(cs, of, counts);
@@ -88,7 +96,11 @@ class Model {
     rep.steps = steps < 0 ? num_steps() : steps;
     rep.initial_total = total_all(cs);
     auto counts = neighbor_counts(cs);
-    for (int s = 0; s < rep.steps; ++s) step_partition(cs, counts);
+    std::vector<double> amounts(flows_.size(), 0.0);
+    for (int s = 0; s < rep.steps; ++s)
+      step_partition(cs, counts, {}, &amounts);
+    for (size_t fi = 0; fi < flows_.size(); ++fi)
+      flows_[fi]->set_last_execute(amounts[fi]);
     rep.final_total = total_all(cs);
     finish_report(rep, cs, check_conservation, tolerance);
     return rep;
@@ -114,9 +126,14 @@ class Model {
 
     std::vector<std::thread> threads;
     std::vector<double> partials(n, 0.0);
+    // per-rank flow amounts: rank r writes row r only; the join below is
+    // the happens-before edge for the rank-0-style combine
+    std::vector<std::vector<double>> amounts(
+        n, std::vector<double>(flows_.size(), 0.0));
     for (int r = 0; r < n; ++r) {
       threads.emplace_back([&, r]() {
-        worker(locals[r], comm, r, lines, columns, rep.steps, partials);
+        worker(locals[r], comm, r, lines, columns, rep.steps, partials,
+               amounts[r]);
       });
     }
     for (auto& t : threads) t.join();
@@ -126,6 +143,13 @@ class Model {
     double final_total = 0.0;
     for (double p : partials) final_total += p;
     for (const auto& lp : locals) cs.merge(lp);
+    // Flow::last_execute = global amount of the final step (sum of the
+    // per-rank shares — a point flow contributes on its owner rank only)
+    for (size_t fi = 0; fi < flows_.size(); ++fi) {
+      double a = 0.0;
+      for (int r = 0; r < n; ++r) a += amounts[r][fi];
+      flows_[fi]->set_last_execute(a);
+    }
     rep.final_total = final_total;
     finish_report(rep, cs, check_conservation, tolerance);
     return rep;
@@ -136,7 +160,8 @@ class Model {
   enum Tag : int { kLeft = 1, kRight = 2, kUp = 3, kDown = 4, kSum = 99 };
 
   void worker(CellularSpace& local, ThreadComm& comm, int rank, int lines,
-              int columns, int nsteps, std::vector<double>& partials) const {
+              int columns, int nsteps, std::vector<double>& partials,
+              std::vector<double>& my_amounts) const {
     const int pi = rank / columns, pj = rank % columns;
     const int h = local.dim_x(), w = local.dim_y();
     const size_t pw = static_cast<size_t>(w) + 2;
@@ -183,7 +208,8 @@ class Model {
       }
     };
 
-    for (int s = 0; s < nsteps; ++s) step_partition(local, counts, fill);
+    for (int s = 0; s < nsteps; ++s)
+      step_partition(local, counts, fill, &my_amounts);
 
     // partition reduction (Model.hpp:238-243)
     partials[rank] = total_all(local);

@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Sanitizer sweep over the threaded native backend.
+#
+# The reference's multi-rank path has the unmatched-send / misordered
+# halo defect class baked in (SURVEY §2: ModelRectangular.hpp:199-220
+# sends with no receiver; commented MPI_Irecv misuse at :96-99). Our
+# ThreadComm backend (include/mmtpu/backend.hpp) hand-rolls the same
+# architecture with mutex/condvar mailboxes, so it gets the tooling the
+# reference never had: a TSan (and optionally ASan/UBSan) build driving
+# every decomposition shape the engine supports, including the
+# reference's exact halo-crossing scenario.
+#
+# Usage: native/scripts/sanitize.sh [thread|address|undefined]
+set -euo pipefail
+SAN="${1:-thread}"
+DIR="$(cd "$(dirname "$0")/.." && pwd)"
+BUILD="$DIR/build-$SAN"
+
+cmake -B "$BUILD" -S "$DIR" -G Ninja \
+  -DMMTPU_SANITIZE="$SAN" -DMMTPU_EMBED_PYTHON=OFF \
+  -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
+cmake --build "$BUILD" >/dev/null
+
+run() {
+  echo "== mmtpu_main $*"
+  "$BUILD/mmtpu_main" "$@"
+}
+
+# reference scenario: source (19,3) on a stripe edge → cross-rank halo
+run --backend=threads --workers=5 --source=19,3
+# many ranks, many steps: stress mailbox reuse across steps
+run --backend=threads --workers=8 --dimx=64 --dimy=64 --steps=50 \
+    --flow=diffusion
+# 2-D block decomposition: corner (two-hop) halo traffic
+run --backend=threads --lines=2 --columns=3 --dimx=60 --dimy=60 \
+    --steps=20 --flow=diffusion
+run --backend=threads --lines=3 --columns=3 --dimx=48 --dimy=48 \
+    --steps=10 --source=15,15
+# degenerate shapes: single rank, single row/column per rank
+run --backend=threads --workers=1 --dimx=16 --dimy=16 --steps=5
+run --backend=threads --workers=16 --dimx=16 --dimy=32 --steps=5 \
+    --flow=diffusion
+
+echo "sanitize($SAN): ALL RUNS CLEAN"

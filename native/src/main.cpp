@@ -186,16 +186,42 @@ int run_tpu(const Args& a, int, char**) {
   else
     py << "flow = mm.Exponencial(mm.Cell(" << a.src_x << ", " << a.src_y
        << ", mm.Attribute(99, " << a.value << ")), " << a.rate << ")\n";
-  py << "model = mm.Model(flow, " << a.time << ", " << a.time_step << ")\n"
-     << "out, rep = model.execute(space, steps="
-     << (a.use_time_loop ? -1 : a.steps)
-     << " if " << (a.use_time_loop ? "False" : "True") << " else None)\n"
+  py << "model = mm.Model(flow, " << a.time << ", " << a.time_step << ")\n";
+  if (a.use_time_loop)
+    py << "out, rep = model.execute(space, check_conservation=False)\n";
+  else
+    py << "out, rep = model.execute(space, steps=" << a.steps
+       << ", check_conservation=False)\n";
+  // Status is COMPUTED from the report against the model's scale-aware
+  // threshold (the native backends' rep.conserved equivalent) — a
+  // violated contract prints VIOLATED and exits 1.
+  py << "ok = rep.conservation_error() <= model.conservation_threshold(\n"
+     << "    out, initial_totals=rep.initial_total)\n"
      << "print(f'backend=tpu ranks={rep.comm_size} steps={rep.steps} '\n"
      << "      f'initial={rep.initial_total} final={rep.final_total} '\n"
-     << "      f'|delta|={rep.conservation_error():.3e} CONSERVED')\n";
+     << "      f'|delta|={rep.conservation_error():.3e} '\n"
+     << "      + ('CONSERVED' if ok else 'VIOLATED'))\n"
+     << "import _mmtpu_driver_rc as _rc\n"
+     << "_rc.value = 0 if ok else 1\n";
+  // rc channel: a tiny module attribute survives PyRun_SimpleString
+  PyRun_SimpleString(
+      "import sys, types\n"
+      "sys.modules['_mmtpu_driver_rc'] = types.SimpleNamespace(value=1)\n");
   int rc = PyRun_SimpleString(py.str().c_str());
+  int status = 1;
+  if (rc == 0) {
+    PyObject* mod = PyImport_ImportModule("_mmtpu_driver_rc");
+    if (mod) {
+      PyObject* v = PyObject_GetAttrString(mod, "value");
+      if (v) {
+        status = static_cast<int>(PyLong_AsLong(v));
+        Py_DECREF(v);
+      }
+      Py_DECREF(mod);
+    }
+  }
   Py_Finalize();
-  return rc == 0 ? 0 : 1;
+  return rc == 0 ? status : 1;
 }
 }  // namespace
 #else
