@@ -15,12 +15,19 @@ Layer map (mirrors SURVEY.md §1):
   L4 ``models``          — Model/ModelRectangular (orchestration)
   L5 ``native/`` + CLI   — C++ runtime & driver (Main.cpp)
   —  ``utils``, ``io``   — timing/metrics; checkpoint/restore + output
+  —  ``resilience``      — failure detection + checkpoint-based recovery
 """
 
 from .abstraction import DataType, get_abstraction_data_type
 from .core import Attribute, Cell, CellularSpace, Partition
 from .ops import Coupled, Diffusion, Exponencial, Flow, PointFlow
 from .models import ConservationError, Model, ModelRectangular, Report
+from .resilience import (
+    FailureEvent,
+    SimulationFailure,
+    check_health,
+    supervised_run,
+)
 
 __version__ = "0.1.0"
 
@@ -40,5 +47,9 @@ __all__ = [
     "ModelRectangular",
     "Report",
     "ConservationError",
+    "FailureEvent",
+    "SimulationFailure",
+    "check_health",
+    "supervised_run",
     "__version__",
 ]

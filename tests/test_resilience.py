@@ -1,0 +1,191 @@
+"""Failure detection + checkpoint-based recovery (resilience subsystem).
+
+The reference has no failure handling — MPI return codes are ignored and
+a failed rank hangs the job (SURVEY §5). These tests prove the
+supervisor detects injected faults (executor exceptions, NaN poisoning,
+conservation violations), recovers by rolling back to the last good
+state, and produces final state BIT-IDENTICAL to an uninterrupted run —
+and that persistent failures surface as SimulationFailure with a full
+event log instead of hanging or silently corrupting."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_model_tpu import CellularSpace, Diffusion, Model
+from mpi_model_tpu.io import CheckpointManager
+from mpi_model_tpu.models.model import SerialExecutor
+from mpi_model_tpu.resilience import (
+    FailureEvent,
+    SimulationFailure,
+    SupervisedResult,
+    check_health,
+    supervised_run,
+)
+
+RNG = np.random.default_rng(5)
+
+
+def make_space(h=12, w=16):
+    vals = jnp.asarray(RNG.uniform(0.5, 2.0, (h, w)), dtype=jnp.float64)
+    return CellularSpace.create(h, w, 1.0, dtype=jnp.float64).with_values(
+        {"value": vals})
+
+
+def make_model():
+    return Model(Diffusion(0.1), time=8.0, time_step=1.0)
+
+
+class FaultyExecutor:
+    """SerialExecutor that fails on chosen call indices (0-based), either
+    by raising or by corrupting the returned state."""
+
+    comm_size = 1
+
+    def __init__(self, fail_calls, mode="raise"):
+        self.fail_calls = set(fail_calls)
+        self.mode = mode
+        self.calls = 0
+        self._inner = SerialExecutor()
+
+    def run_model(self, model, space, num_steps):
+        idx = self.calls
+        self.calls += 1
+        if idx in self.fail_calls:
+            if self.mode == "raise":
+                raise RuntimeError(f"injected device fault on call {idx}")
+            out = self._inner.run_model(model, space, num_steps)
+            if self.mode == "nan":
+                out = dict(out)
+                out["value"] = out["value"].at[1, 1].set(jnp.nan)
+                return out
+            if self.mode == "leak":  # silently lose mass
+                return {k: v * 0.9 for k, v in out.items()}
+            raise AssertionError(f"unknown mode {self.mode}")
+        return self._inner.run_model(model, space, num_steps)
+
+
+# -- check_health -----------------------------------------------------------
+
+def test_check_health_clean():
+    space = make_space()
+    assert check_health(space) == []
+    init = {"value": float(space.total("value"))}
+    assert check_health(space, init, threshold=1e-6) == []
+
+
+def test_check_health_detects_nonfinite():
+    space = make_space()
+    bad = space.with_values(
+        {"value": space.values["value"].at[0, 0].set(jnp.inf)})
+    problems = check_health(bad)
+    assert len(problems) == 1 and "non-finite" in problems[0]
+
+
+def test_check_health_detects_drift():
+    space = make_space()
+    init = {"value": float(space.total("value")) + 1.0}
+    problems = check_health(space, init, threshold=0.5)
+    assert len(problems) == 1 and "conservation drift" in problems[0]
+
+
+# -- recovery ---------------------------------------------------------------
+
+def expected_final(model, space, steps=8):
+    out, _ = model.execute(space, steps=steps)
+    return np.asarray(out.values["value"])
+
+
+@pytest.mark.parametrize("mode", ["raise", "nan", "leak"])
+def test_transient_failure_recovers_bit_identical(mode):
+    space = make_space()
+    model = make_model()
+    want = expected_final(model, space)
+
+    events_seen = []
+    ex = FaultyExecutor(fail_calls={2}, mode=mode)
+    res = supervised_run(model, space, steps=8, every=2, executor=ex,
+                         on_event=events_seen.append)
+    assert isinstance(res, SupervisedResult)
+    assert res.step == 8
+    assert res.recovered_failures == 1
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]), want)  # bit-identical
+
+    (ev,) = res.events
+    assert events_seen == [ev]
+    assert isinstance(ev, FailureEvent)
+    expected_kind = {"raise": "exception", "nan": "nonfinite",
+                     "leak": "conservation"}[mode]
+    assert ev.kind == expected_kind
+    assert ev.rolled_back_to == 4  # chunks of 2: calls 0,1 good, 2 fails
+    assert ev.attempt == 1
+
+
+def test_persistent_failure_raises_with_event_log():
+    space = make_space()
+    model = make_model()
+    ex = FaultyExecutor(fail_calls=set(range(100)))
+    with pytest.raises(SimulationFailure) as ei:
+        supervised_run(model, space, steps=4, every=2, executor=ex,
+                       max_failures=3)
+    # max_failures=3 consecutive retries allowed -> 4th failure raises
+    assert len(ei.value.events) == 4
+    assert all(e.rolled_back_to == 0 for e in ei.value.events)
+    assert [e.attempt for e in ei.value.events] == [1, 2, 3, 4]
+
+
+def test_consecutive_counter_resets_on_success():
+    space = make_space()
+    model = make_model()
+    # fail calls 0,1 (attempts 1,2), succeed, then fail 3,4 — each burst
+    # stays within max_failures=2 because success resets the counter
+    ex = FaultyExecutor(fail_calls={0, 1, 3, 4})
+    res = supervised_run(model, space, steps=4, every=2, executor=ex,
+                         max_failures=2)
+    assert res.step == 4
+    assert res.recovered_failures == 4
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]),
+        expected_final(model, space, steps=4))
+
+
+def test_durable_recovery_resumes_across_restart(tmp_path):
+    """Process-death recovery: first supervised run dies mid-way (a
+    persistent fault), a NEW supervisor picks up the manager's latest
+    checkpoint and finishes; the result is bit-identical to an
+    uninterrupted run — including the conservation baseline, which
+    travels inside the checkpoint."""
+    space = make_space()
+    model = make_model()
+    want = expected_final(model, space)
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+
+    ex1 = FaultyExecutor(fail_calls={2, 3, 4, 5, 6})  # dies after step 4
+    with pytest.raises(SimulationFailure):
+        supervised_run(model, space, mgr, steps=8, every=2, executor=ex1,
+                       max_failures=2)
+
+    # "restart": fresh supervisor, fresh executor, same manager
+    res = supervised_run(model, make_space(), mgr, steps=8, every=2,
+                         executor=SerialExecutor())
+    assert res.step == 8
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]), want)
+
+
+def test_supervised_run_validates_args():
+    space = make_space()
+    model = make_model()
+    with pytest.raises(ValueError, match="every"):
+        supervised_run(model, space, steps=4, every=0)
+
+
+def test_clean_run_has_no_events_and_matches_plain_execute():
+    space = make_space()
+    model = make_model()
+    res = supervised_run(model, space, steps=8, every=3)  # uneven chunks
+    assert res.events == []
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]), expected_final(model, space))
+    assert res.report is not None and res.report.steps == 2  # last chunk
