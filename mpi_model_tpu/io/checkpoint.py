@@ -149,26 +149,25 @@ class CheckpointManager:
 
 def run_checkpointed(model, space: CellularSpace, manager: CheckpointManager,
                      *, steps: Optional[int] = None, every: int = 1,
-                     executor=None, **execute_kwargs):
+                     executor=None, check_conservation: bool = True,
+                     tolerance: float = 1e-3, rtol: Optional[float] = None):
     """Run ``model`` for ``steps`` (default ``model.num_steps``), saving a
     checkpoint every ``every`` steps and RESUMING from ``manager.latest()``
     when one exists. Restarting after any interruption continues from the
     last saved step and yields state bit-identical to an uninterrupted
-    run (proven in tests/test_io.py)."""
-    total = model.num_steps if steps is None else int(steps)
-    start = 0
-    ck = manager.latest()
-    if ck is not None:
-        if ck.step > total:
-            raise ValueError(
-                f"latest checkpoint is at step {ck.step} > requested total "
-                f"{total}")
-        space, start = ck.space, ck.step
-    report = None
-    while start < total:
-        n = min(every, total - start)
-        space, report = model.execute(space, executor, steps=n,
-                                      **execute_kwargs)
-        start += n
-        manager.save(space, start)
-    return space, start, report
+    run (proven in tests/test_io.py).
+
+    This is ``resilience.supervised_run`` with recovery disabled
+    (``max_failures=0``): the same resume/chunk driver, so checkpoints
+    written here carry the run's conservation baseline and interoperate
+    with supervised runs. ``check_conservation`` maps onto the
+    supervisor's in-band health checks (drift is bounded against the
+    RUN-global initial totals, and a violation surfaces as
+    ``SimulationFailure`` wrapping the health report)."""
+    from ..resilience import supervised_run
+
+    res = supervised_run(model, space, manager, steps=steps, every=every,
+                         max_failures=0, executor=executor,
+                         health_checks=check_conservation,
+                         tolerance=tolerance, rtol=rtol)
+    return res.space, res.step, res.report

@@ -335,15 +335,23 @@ class Model:
             executor = self._default_executor
         num_steps = self.num_steps if steps is None else steps
 
-        initial = {k: float(space.total(k)) for k in space.values}
-        t0 = _time.perf_counter()
-        out_values = executor.run_model(self, space, num_steps)
-        out_values = jax.tree.map(jax.block_until_ready, out_values)
-        wall = _time.perf_counter() - t0
+        from ..utils.tracing import trace_span
 
-        out_space = space.with_values(out_values)
-        final = {k: float(out_space.total(k)) for k in out_space.values}
-        last_exec = [float(f.execute(out_space)) for f in self.flows]
+        with trace_span("model.execute", steps=num_steps,
+                        executor=type(executor).__name__):
+            initial = {k: float(space.total(k)) for k in space.values}
+            t0 = _time.perf_counter()
+            with trace_span("executor.run"):
+                out_values = executor.run_model(self, space, num_steps)
+                out_values = jax.tree.map(jax.block_until_ready, out_values)
+            wall = _time.perf_counter() - t0
+
+            with trace_span("model.report"):
+                out_space = space.with_values(out_values)
+                final = {k: float(out_space.total(k))
+                         for k in out_space.values}
+                last_exec = [float(f.execute(out_space))
+                             for f in self.flows]
 
         report = Report(
             comm_size=getattr(executor, "comm_size", 1),

@@ -195,19 +195,26 @@ class ShardMapExecutor:
         put = partial(put_global, sharding=sharding)
         values = {k: put(v) for k, v in space.values.items()}
 
+        from ..utils.tracing import get_tracer
+
         entry = self._cache.get(key)
         if entry is None:
+            tracer = get_tracer()
             rates = self._pallas_eligible_rates(model, space)
             if rates is not None:
-                prunner = self._build_pallas_runner(model, space, num_steps,
-                                                    rates)
+                with tracer.span("shardmap.build", impl="pallas",
+                                 steps=num_steps):
+                    prunner = self._build_pallas_runner(
+                        model, space, num_steps, rates)
                 # first call traces+compiles; block_until_ready so
                 # async-dispatched device-side faults surface HERE, not
                 # in the caller after a broken runner got cached. On
                 # failure "auto" degrades to the XLA path (mirrors
                 # Model.make_step's fallback).
                 try:
-                    out = jax.block_until_ready(prunner(values))
+                    with tracer.span("shardmap.compile+first_run",
+                                     impl="pallas"):
+                        out = jax.block_until_ready(prunner(values))
                 except Exception as e:
                     if self.step_impl == "pallas":
                         raise
@@ -217,7 +224,8 @@ class ShardMapExecutor:
                 else:
                     self._cache[key] = ("pallas", prunner)
                     return out
-            entry = ("xla", self._build_runner(model, space, num_steps))
+            with tracer.span("shardmap.build", impl="xla", steps=num_steps):
+                entry = ("xla", self._build_runner(model, space, num_steps))
             self._cache[key] = entry
         kind, runner = entry
         if kind == "pallas":

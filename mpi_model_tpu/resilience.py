@@ -36,9 +36,6 @@ import dataclasses
 import time as _time
 from typing import Callable, Optional
 
-import jax
-import numpy as np
-
 from .core.cellular_space import CellularSpace
 from .io.checkpoint import CheckpointManager
 from .models.model import Model, Report
@@ -107,19 +104,28 @@ def check_health(space: CellularSpace,
     """Detect bad simulation state; returns a list of problems (empty =
     healthy). Checks every attribute channel for non-finite values and —
     when ``initial_totals``/``threshold`` are given — total-mass drift
-    beyond the conservation contract. All device work is one ``isfinite``
-    ``all`` and one ``sum`` per channel."""
+    beyond the conservation contract. All checks are device-side
+    reductions (one ``isfinite().all()``, one ``sum`` per channel,
+    accumulated in f32-or-wider); only scalars cross to the host, so the
+    check is cheap even at 1e8 cells and on sharded arrays (the sums
+    lower to ICI all-reduces)."""
+    import jax.numpy as jnp
+
     problems: list[str] = []
+    checks = []
     for name, arr in space.values.items():
-        a = np.asarray(jax.device_get(arr), dtype=np.float64)
-        if not np.isfinite(a).all():
-            bad = int(np.size(a) - np.isfinite(a).sum())
+        acc = jnp.promote_types(arr.dtype, jnp.float32)
+        checks.append((name,
+                       jnp.isfinite(arr).all(),
+                       jnp.sum(arr, dtype=acc)))
+    for name, finite, total in checks:  # device work above, sync here
+        if not bool(finite):
             problems.append(
-                f"channel {name!r}: {bad} non-finite cell(s) "
+                f"channel {name!r}: non-finite cell(s) "
                 "(NaN/Inf divergence)")
             continue  # totals of a non-finite channel are meaningless
         if initial_totals is not None and threshold is not None:
-            drift = abs(float(a.sum()) - initial_totals[name])
+            drift = abs(float(total) - initial_totals[name])
             if drift > threshold:
                 problems.append(
                     f"channel {name!r}: conservation drift {drift:.3e} > "
@@ -144,6 +150,7 @@ def supervised_run(
     every: int = 1,
     max_failures: int = 3,
     executor=None,
+    health_checks: bool = True,
     tolerance: float = 1e-3,
     rtol: Optional[float] = None,
     on_event: Optional[Callable[[FailureEvent], None]] = None,
@@ -164,7 +171,9 @@ def supervised_run(
     from its latest checkpoint (the original initial totals travel inside
     the checkpoint's ``extra``, so the conservation baseline survives the
     restart). ``on_event`` observes each ``FailureEvent`` as it happens
-    (wire it to logging/metrics).
+    (wire it to logging/metrics). ``health_checks=False`` disables the
+    in-band state checks (executor exceptions are still supervised) —
+    ``io.run_checkpointed`` is this function with ``max_failures=0``.
     """
     total = model.num_steps if steps is None else int(steps)
     if every <= 0:
@@ -185,8 +194,9 @@ def supervised_run(
                 initial = {k: float(v) for k, v in saved.items()}
     if initial is None:
         initial = {k: float(space.total(k)) for k in space.values}
-    threshold = model.conservation_threshold(
+    threshold = (model.conservation_threshold(
         space, tolerance, rtol, initial_totals=initial)
+        if health_checks else None)
 
     # Last good state: durable via the manager when present, always also
     # in memory so rollback never needs disk on the hot path.
@@ -206,9 +216,10 @@ def supervised_run(
             # execute()'s own per-chunk check would re-baseline each chunk
             out_space, report = model.execute(
                 good_space, executor, steps=n, check_conservation=False)
-            problems = check_health(out_space, initial, threshold)
-            if problems:
-                raise HealthError(problems)
+            if health_checks:
+                problems = check_health(out_space, initial, threshold)
+                if problems:
+                    raise HealthError(problems)
         except Exception as exc:  # noqa: BLE001 — supervisor boundary
             consecutive += 1
             ev = FailureEvent(

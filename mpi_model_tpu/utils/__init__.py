@@ -1,3 +1,12 @@
 from .metrics import marginal_runner_time, marginal_step_time
+from .tracing import Span, Tracer, get_tracer, set_tracer, trace_span
 
-__all__ = ["marginal_step_time", "marginal_runner_time"]
+__all__ = [
+    "marginal_step_time",
+    "marginal_runner_time",
+    "Span",
+    "Tracer",
+    "get_tracer",
+    "set_tracer",
+    "trace_span",
+]

@@ -1,0 +1,196 @@
+"""Structured tracing — the observability layer the reference lacks.
+
+The reference's only tracing is ``cout << __FILE__ << ": " << __LINE__``
+at unhandled branches and a ``__TIMESTAMP__`` in the output filename
+(SURVEY §5: "No timers anywhere — the reference never measures its own
+speed"). Here tracing is structured and first-class:
+
+- ``Tracer.span(name)`` — nested, thread-safe wall-clock spans with
+  per-thread nesting (one span stack per thread, like a profiler);
+- ``summary()`` — per-name aggregates (count / total / mean / max);
+- ``export_chrome()`` — the Chrome trace-event format, loadable in
+  ``chrome://tracing`` / Perfetto alongside XLA's own device traces;
+- ``device_trace()`` — wraps ``jax.profiler.trace`` so host spans and
+  the XLA/TPU device profile are captured over the same window (this is
+  how BASELINE's halo-exchange share is attributed on real hardware);
+- a process-wide default tracer (``get_tracer``/``trace_span``) that the
+  framework's own phases report into: ``Model.execute`` emits
+  ``model.execute`` / ``executor.run``, the sharded executors emit their
+  build-vs-run phases.
+
+Recording one span is two ``perf_counter`` calls and a list append —
+cheap enough to leave on; ``Tracer(enabled=False)`` makes it free.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Iterator, Optional
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "get_tracer",
+    "set_tracer",
+    "trace_span",
+]
+
+
+@dataclasses.dataclass
+class Span:
+    """One completed span. ``start_s`` is ``perf_counter``-based and only
+    meaningful relative to other spans from the same tracer."""
+
+    name: str
+    start_s: float
+    duration_s: float
+    thread: int
+    depth: int
+    meta: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class Tracer:
+    """Thread-safe span recorder with per-thread nesting.
+
+    The buffer is a ring of at most ``max_spans`` (oldest dropped first,
+    ``dropped`` counts them) so the always-on default tracer stays
+    bounded over arbitrarily long runs."""
+
+    def __init__(self, enabled: bool = True, max_spans: int = 20_000):
+        self.enabled = enabled
+        self._spans: collections.deque[Span] = collections.deque(
+            maxlen=int(max_spans))
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    # -- recording ----------------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, **meta: Any) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        depth = getattr(self._local, "depth", 0)
+        self._local.depth = depth + 1
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._local.depth = depth
+            s = Span(name=name, start_s=t0, duration_s=dt,
+                     thread=threading.get_ident(), depth=depth,
+                     meta=dict(meta))
+            self._append(s)
+
+    def instant(self, name: str, **meta: Any) -> None:
+        """Record a zero-duration marker (the structured version of the
+        reference's ``__FILE__:__LINE__`` couts)."""
+        if not self.enabled:
+            return
+        s = Span(name=name, start_s=time.perf_counter(), duration_s=0.0,
+                 thread=threading.get_ident(),
+                 depth=getattr(self._local, "depth", 0), meta=dict(meta))
+        self._append(s)
+
+    def _append(self, s: Span) -> None:
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self.dropped += 1
+            self._spans.append(s)
+
+    # -- inspection ---------------------------------------------------------
+
+    @property
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        """Per-name aggregates: count, total_s, mean_s, max_s."""
+        out: dict[str, dict[str, float]] = {}
+        for s in self.spans:
+            agg = out.setdefault(
+                s.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            agg["count"] += 1
+            agg["total_s"] += s.duration_s
+            agg["max_s"] = max(agg["max_s"], s.duration_s)
+        for agg in out.values():
+            agg["mean_s"] = agg["total_s"] / agg["count"]
+        return out
+
+    # -- export -------------------------------------------------------------
+
+    def chrome_events(self) -> list[dict]:
+        """Spans as Chrome trace-event ``X`` (complete) events, µs."""
+        return [
+            {
+                "name": s.name,
+                "ph": "X",
+                "ts": s.start_s * 1e6,
+                "dur": s.duration_s * 1e6,
+                "pid": 1,
+                "tid": s.thread,
+                "args": s.meta,
+            }
+            for s in self.spans
+        ]
+
+    def export_chrome(self, path: str) -> str:
+        """Write the trace as a ``chrome://tracing``/Perfetto JSON file."""
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.chrome_events(),
+                       "displayTimeUnit": "ms"}, f)
+        return path
+
+    # -- device profiling ----------------------------------------------------
+
+    @contextlib.contextmanager
+    def device_trace(self, logdir: str, name: str = "device_trace"
+                     ) -> Iterator[None]:
+        """Capture an XLA device profile (``jax.profiler.trace``) over the
+        block, alongside a host span of the same name — so host phases
+        can be lined up against compiled-program device time (the way
+        BASELINE's halo-exchange wallclock share is attributed on real
+        hardware)."""
+        import jax
+
+        with self.span(name, logdir=logdir):
+            with jax.profiler.trace(logdir):
+                yield
+
+
+# -- process-wide default tracer ---------------------------------------------
+
+_default = Tracer(enabled=True)
+_default_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    return _default
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    """Swap the process-wide tracer (e.g. a disabled one); returns the
+    previous tracer."""
+    global _default
+    with _default_lock:
+        prev, _default = _default, tracer
+    return prev
+
+
+def trace_span(name: str, **meta: Any):
+    """``get_tracer().span(...)`` resolved at call time (so a tracer
+    swapped in mid-process is honored)."""
+    return get_tracer().span(name, **meta)

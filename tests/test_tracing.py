@@ -1,0 +1,173 @@
+"""Tracing subsystem: nested/threaded span recording, aggregation,
+Chrome trace export, the framework's own phase instrumentation
+(Model.execute / ShardMapExecutor), and the jax.profiler bridge."""
+
+import json
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_model_tpu import CellularSpace, Diffusion, Model
+from mpi_model_tpu.utils import Tracer, get_tracer, set_tracer, trace_span
+
+
+def test_nested_spans_depth_and_duration():
+    tr = Tracer()
+    with tr.span("outer", job=1):
+        with tr.span("inner"):
+            pass
+    inner, outer = tr.spans
+    assert (inner.name, outer.name) == ("inner", "outer")
+    assert inner.depth == 1 and outer.depth == 0
+    assert 0 <= inner.duration_s <= outer.duration_s
+    assert outer.meta == {"job": 1}
+    # inner lies within outer
+    assert outer.start_s <= inner.start_s
+    assert (inner.start_s + inner.duration_s
+            <= outer.start_s + outer.duration_s + 1e-9)
+
+
+def test_span_recorded_on_exception():
+    tr = Tracer()
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("x")
+    assert [s.name for s in tr.spans] == ["boom"]
+
+
+def test_summary_aggregates():
+    tr = Tracer()
+    for _ in range(3):
+        with tr.span("a"):
+            pass
+    with tr.span("b"):
+        pass
+    s = tr.summary()
+    assert s["a"]["count"] == 3 and s["b"]["count"] == 1
+    assert s["a"]["total_s"] >= s["a"]["max_s"] >= s["a"]["mean_s"] >= 0
+
+
+def test_disabled_tracer_records_nothing():
+    tr = Tracer(enabled=False)
+    with tr.span("a"):
+        tr.instant("marker")
+    assert tr.spans == []
+
+
+def test_ring_buffer_bounds_memory():
+    tr = Tracer(max_spans=5)
+    for i in range(9):
+        tr.instant("m", i=i)
+    spans = tr.spans
+    assert len(spans) == 5
+    assert tr.dropped == 4
+    assert [s.meta["i"] for s in spans] == [4, 5, 6, 7, 8]  # oldest dropped
+    tr.clear()
+    assert tr.spans == [] and tr.dropped == 0
+
+
+def test_thread_safety_and_per_thread_nesting():
+    tr = Tracer()
+    # barrier keeps all 8 threads alive at once — thread idents are reused
+    # after a thread exits, which would collapse the uniqueness check
+    barrier = threading.Barrier(8)
+
+    def work(i):
+        barrier.wait(timeout=30)
+        with tr.span("outer", i=i):
+            with tr.span("inner", i=i):
+                pass
+        barrier.wait(timeout=30)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = tr.spans
+    assert len(spans) == 16
+    # nesting depth is per-thread: every inner is depth 1, outer depth 0
+    for s in spans:
+        assert s.depth == (1 if s.name == "inner" else 0)
+    assert len({s.thread for s in spans}) == 8
+
+
+def test_chrome_export(tmp_path):
+    tr = Tracer()
+    with tr.span("phase", detail="x"):
+        pass
+    tr.instant("mark")
+    path = tr.export_chrome(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    assert len(events) == 2
+    for e in events:
+        assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+        assert e["ph"] == "X"
+    assert events[0]["args"] == {"detail": "x"}
+
+
+def test_model_execute_emits_phases():
+    tr = Tracer()
+    prev = set_tracer(tr)
+    try:
+        space = CellularSpace.create(8, 8, 1.0, dtype=jnp.float64)
+        model = Model(Diffusion(0.1), 2.0, 1.0)
+        model.execute(space)
+    finally:
+        set_tracer(prev)
+    names = [s.name for s in tr.spans]
+    assert "model.execute" in names
+    assert "executor.run" in names
+    assert "model.report" in names
+    ex = next(s for s in tr.spans if s.name == "model.execute")
+    assert ex.meta["steps"] == 2
+    assert ex.meta["executor"] == "SerialExecutor"
+    # executor.run nested inside model.execute
+    run = next(s for s in tr.spans if s.name == "executor.run")
+    assert run.depth == ex.depth + 1
+
+
+def test_shardmap_executor_emits_build_phase(eight_devices):
+    from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh
+
+    tr = Tracer()
+    prev = set_tracer(tr)
+    try:
+        mesh = make_mesh(4, devices=eight_devices[:4])
+        space = CellularSpace.create(16, 16, 1.0, dtype=jnp.float64)
+        model = Model(Diffusion(0.1), 1.0, 1.0)
+        out, _ = model.execute(space, ShardMapExecutor(mesh))
+        assert np.isfinite(np.asarray(out.values["value"])).all()
+    finally:
+        set_tracer(prev)
+    builds = [s for s in tr.spans if s.name == "shardmap.build"]
+    assert len(builds) == 1 and builds[0].meta["impl"] == "xla"
+
+
+def test_trace_span_uses_current_default():
+    tr = Tracer()
+    prev = set_tracer(tr)
+    try:
+        with trace_span("x"):
+            pass
+        assert get_tracer() is tr
+    finally:
+        set_tracer(prev)
+    assert [s.name for s in tr.spans] == ["x"]
+
+
+def test_device_trace_writes_profile(tmp_path):
+    tr = Tracer()
+    logdir = str(tmp_path / "prof")
+    with tr.device_trace(logdir):
+        _ = jnp.sum(jnp.ones((16, 16))).block_until_ready()
+    assert [s.name for s in tr.spans] == ["device_trace"]
+    import os
+    found = []
+    for root, _dirs, files in os.walk(logdir):
+        found += files
+    assert found, "jax.profiler.trace wrote no profile files"
