@@ -2,12 +2,20 @@
 
 Measures the framework's headline metric (BASELINE.json: cell-updates/sec/
 chip; north star >=1e9 on a 1e8-cell grid) on the real TPU chip, using the
-fused Pallas kernel (ops.pallas_stencil) with donated buffers via
-``make_step(impl="auto")`` (the framework falls back to the XLA stencil
-path if the Pallas compile fails). Prints ONE JSON line:
+fused Pallas kernel (ops.pallas_stencil) with multi-step fusion
+(``substeps`` flow steps per HBM round-trip — the bandwidth-amortizing
+fast path) and donated buffers via ``make_step(impl="auto")`` (the
+framework falls back to the XLA stencil path if the Pallas compile
+fails). Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 vs_baseline is value / 1e9 (the north-star target — the reference itself
 publishes no numbers, SURVEY §6).
+
+Before timing, the kernel is VALIDATED ON THE BENCH DEVICE against the
+NumPy oracle (single- and multi-step, tolerance scaled to dtype) — the
+hardware-correctness gate that round-2 VERDICT weak #9 found missing; a
+mismatch aborts the run with an error JSON instead of reporting a fast
+wrong kernel.
 
 Timing note: the remote-TPU tunnel adds ~100ms fixed dispatch overhead
 per call, so the per-step cost is measured MARGINALLY — two scan lengths
@@ -25,31 +33,77 @@ import json
 import sys
 
 
+def validate_on_device(substeps: int, verbose: bool = False) -> float:
+    """Golden-check the step the bench is about to time, on the bench
+    device, against the composed NumPy oracle. The grid is 1536x1536 —
+    3x3 tiles at the default (512,512) block — so GENUINE INTERIOR tiles
+    exercise the multi-step fast path (a single-tile grid would be
+    entirely 'near-ring' and only check the exact masked branch). Runs
+    in f32 (tight tolerance) and in the bench dtype bf16 (storage-
+    rounding tolerance). Returns the worst max-abs-error; raises on
+    mismatch."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_model_tpu import CellularSpace, Diffusion, Model
+    from mpi_model_tpu.oracle import dense_flow_step_np
+
+    rng = np.random.default_rng(12)
+    g = 1536
+    v0 = rng.uniform(0.5, 2.0, (g, g)).astype(np.float32)
+    want = v0.astype(np.float64)
+    for _ in range(max(1, substeps)):
+        want = dense_flow_step_np(want, 0.1)
+
+    worst = 0.0
+    for dtype, tol in ((jnp.float32, 1e-5 * max(1, substeps)),
+                       (jnp.bfloat16, 0.04)):
+        space = CellularSpace.create(g, g, 1.0, dtype=dtype)
+        space = space.with_values({"value": jnp.asarray(v0, dtype)})
+        model = Model(Diffusion(0.1), 1.0, 1.0)
+        step = model.make_step(space, impl="auto", substeps=substeps)
+        got = np.asarray(step(dict(space.values))["value"], np.float64)
+        err = float(np.abs(got - want).max())
+        worst = max(worst, err)
+        if err > tol:
+            raise AssertionError(
+                f"on-device validation failed ({jnp.dtype(dtype).name}): "
+                f"max|err|={err:.3e} > {tol:.1e} vs the NumPy oracle "
+                f"({substeps} steps, impl={step.impl})")
+        if verbose:
+            print(f"  on-device validation OK ({jnp.dtype(dtype).name}): "
+                  f"max|err|={err:.2e} (impl={step.impl}, "
+                  f"substeps={substeps})", file=sys.stderr)
+    return worst
+
+
 def bench(grid: int = 16384, dtype_name: str = "bfloat16",
-          verbose: bool = False) -> dict:
-    import jax
+          substeps: int = 4, verbose: bool = False) -> dict:
     import jax.numpy as jnp
 
     from mpi_model_tpu import CellularSpace, Diffusion, Model
+    from mpi_model_tpu.utils import marginal_step_time
+
+    validate_on_device(substeps, verbose=verbose)
 
     dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
     space = CellularSpace.create(grid, grid, 1.0, dtype=dtype)
     model = Model(Diffusion(0.1), 1.0, 1.0)
 
-    from mpi_model_tpu.utils import marginal_step_time
-
-    # "auto" prefers the fused Pallas kernel and falls back to the XLA
-    # stencil inside the framework if the kernel fails to compile
-    step = model.make_step(space, impl="auto")
+    # "auto" prefers the fused Pallas kernel (multi-step fused: substeps
+    # flow steps per HBM round-trip) and falls back to the XLA stencil
+    # inside the framework if the kernel fails to compile
+    step = model.make_step(space, impl="auto", substeps=substeps)
     impl_used = step.impl
-    t = marginal_step_time(step, dict(space.values))
+    t = marginal_step_time(step, dict(space.values), s1=10, s2=60, reps=3)
 
-    cups = grid * grid / t
+    cups = grid * grid * substeps / t
     if verbose:
-        print(f"  impl={impl_used}: {t*1000:.3f} ms/step", file=sys.stderr)
+        print(f"  impl={impl_used}: {t*1000/substeps:.3f} ms/step "
+              f"({substeps} fused)", file=sys.stderr)
     return {
         "metric": f"cell-updates/sec/chip (dense Moore-8 flow step, "
-                  f"{grid}x{grid} {dtype_name}, {impl_used})",
+                  f"{grid}x{grid} {dtype_name}, {impl_used} x{substeps})",
         "value": cups,
         "unit": "cell-updates/s",
         "vs_baseline": cups / 1e9,
@@ -57,5 +111,11 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
 
 
 if __name__ == "__main__":
-    result = bench(verbose="-v" in sys.argv)
+    try:
+        result = bench(verbose="-v" in sys.argv)
+    except Exception as e:  # noqa: BLE001 — single-line contract
+        print(json.dumps({"metric": "bench failed", "value": 0.0,
+                          "unit": "error", "vs_baseline": 0.0,
+                          "error": str(e)[:500]}))
+        sys.exit(1)
     print(json.dumps(result))

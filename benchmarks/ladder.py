@@ -89,8 +89,10 @@ def native_cups(grid: int, workers: int = 4) -> float | None:
 # -- framework measurements --------------------------------------------------
 
 def tpu_serial_cups(grid: int, dtype_name: str, flows, impl: str = "auto",
-                    s1: int = 20, s2: int = 100) -> dict:
-    """Serial (single-chip) cell-updates/sec via Model.make_step."""
+                    s1: int = 20, s2: int = 100, substeps: int = 1) -> dict:
+    """Serial (single-chip) cell-updates/sec via Model.make_step.
+    ``substeps > 1`` times the multi-step-fused kernel (substeps flow
+    steps per HBM round-trip); cups still counts true cell-updates."""
     import jax.numpy as jnp
 
     from mpi_model_tpu import CellularSpace, Model
@@ -102,10 +104,12 @@ def tpu_serial_cups(grid: int, dtype_name: str, flows, impl: str = "auto",
     space = CellularSpace.create(grid, grid,
                                  {a: 1.0 for a in attrs} or 1.0, dtype=dtype)
     model = Model(list(flows), 1.0, 1.0)
-    step = model.make_step(space, impl=impl)
+    step = model.make_step(space, impl=impl, substeps=substeps)
     t = marginal_step_time(step, dict(space.values), s1=s1, s2=s2)
-    return {"cups": grid * grid / t, "step_ms": t * 1e3,
-            "impl": getattr(step, "impl", impl)}
+    return {"cups": grid * grid * substeps / t,
+            "step_ms": t * 1e3 / substeps,
+            "impl": getattr(step, "impl", impl),
+            "substeps": substeps}
 
 
 def sharded_cups_and_halo(grid: int, mesh_shape: tuple, dtype_name: str,
@@ -237,16 +241,21 @@ def config4(quick: bool = False) -> dict:
 
 
 def config5(quick: bool = False) -> dict:
-    """16384^2 Moore-8 fused Pallas kernel, single chip (v4-32 scaled)."""
+    """16384^2 Moore-8 fused Pallas kernel, single chip (v4-32 scaled);
+    multi-step fusion (4 steps per HBM round-trip) vs single-step."""
     from mpi_model_tpu import Diffusion
 
     g = 128 if quick else 16384
-    r = tpu_serial_cups(g, "bfloat16", [Diffusion(0.1)], s1=10, s2=50)
+    r1 = tpu_serial_cups(g, "bfloat16", [Diffusion(0.1)], s1=10, s2=50)
+    r4 = tpu_serial_cups(g, "bfloat16", [Diffusion(0.1)], s1=10,
+                         s2=50 if quick else 40, substeps=4)
     return {
         "config": 5, "grid": g, "flow": "diffusion",
         "strategy": "fused Pallas, single TPU chip",
-        "framework_cups": r["cups"], "impl": r["impl"],
-        "step_ms": r["step_ms"],
+        "framework_cups": r4["cups"], "impl": r4["impl"],
+        "step_ms": r4["step_ms"], "substeps": 4,
+        "single_step_cups": r1["cups"], "multistep_speedup":
+            r4["cups"] / r1["cups"] if r1["cups"] else None,
     }
 
 

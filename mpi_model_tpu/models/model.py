@@ -94,26 +94,41 @@ class SerialExecutor:
 
     ``step_impl`` selects the per-step kernel: ``"xla"`` (fused stencil
     ops), ``"pallas"`` (the fused TPU kernel — Diffusion-only field flows),
-    or ``"auto"`` (pallas when eligible).
+    or ``"auto"`` (pallas when eligible). ``substeps`` batches that many
+    model steps into each compiled step call (``Model.make_step``'s
+    multi-step fusion — the HBM-amortizing fast path on TPU); any
+    remainder of ``num_steps`` runs as single steps, so semantics are
+    independent of the setting.
     """
 
     comm_size = 1
 
-    def __init__(self, step_impl: str = "xla"):
+    def __init__(self, step_impl: str = "xla", substeps: int = 1):
         self.step_impl = step_impl
+        self.substeps = max(1, int(substeps))
         self._cache: dict = {}
 
     def run_model(self, model: "Model", space: CellularSpace,
                   num_steps: int) -> Values:
-        step = model.make_step(space, impl=self.step_impl)
-        key = (step, num_steps)
+        # q multi-step calls + r single-step calls == num_steps steps
+        q, r = divmod(num_steps, self.substeps)
+        stepk = model.make_step(space, impl=self.step_impl,
+                                substeps=self.substeps) if q else None
+        step1 = model.make_step(space, impl=self.step_impl) if r else None
+        key = (stepk, step1, q, r)
         runner = self._cache.get(key)
         if runner is None:
             def _run(v):
-                def body(c, _):
-                    return step(c), None
-                out, _ = jax.lax.scan(body, v, None, length=num_steps)
-                return out
+                def scan_of(fn, c, length):
+                    def body(carry, _):
+                        return fn(carry), None
+                    out, _ = jax.lax.scan(body, c, None, length=length)
+                    return out
+                if q:
+                    v = scan_of(stepk, v, q)
+                if r:
+                    v = scan_of(step1, v, r)
+                return v
             runner = jax.jit(_run)
             self._cache[key] = runner
         return runner(dict(space.values))
@@ -166,8 +181,8 @@ class Model:
             rates[f.attr] = rates.get(f.attr, 0.0) + f.flow_rate
         return rates
 
-    def make_step(self, space: CellularSpace,
-                  impl: str = "xla") -> Callable[[Values], Values]:
+    def make_step(self, space: CellularSpace, impl: str = "xla",
+                  substeps: int = 1) -> Callable[[Values], Values]:
         """Build the pure per-step function for this space's geometry.
 
         Point-source flows take the sparse scatter path
@@ -184,15 +199,27 @@ class Model:
         ``ValueError`` otherwise), or ``"auto"`` (pallas when eligible
         AND its compile succeeds — a trace/lowering/compile failure falls
         back to xla instead of propagating). The returned step carries
-        ``.impl`` naming the kernel actually in use."""
+        ``.impl`` naming the kernel actually in use.
+
+        ``substeps > 1`` returns a step that advances the model that many
+        steps per call. On the Pallas path the steps are fused INSIDE the
+        kernel (one HBM round-trip for all of them — the bandwidth
+        amortization that pushes the TPU kernel toward its roofline;
+        requires Diffusion-only models, since a point flow must fire
+        between sub-steps); elsewhere the single step is composed
+        ``substeps`` times inside one jitted call, which is semantically
+        identical to calling the step repeatedly."""
         if not jnp.issubdtype(space.dtype, jnp.floating):
             raise TypeError(
                 f"flow transport requires a floating dtype, got {space.dtype}"
                 " (integer channels are supported for storage/comm, not flows)")
         if impl not in ("xla", "pallas", "auto"):
             raise ValueError(f"unknown step impl {impl!r}")
+        substeps = int(substeps)
+        if substeps < 1:
+            raise ValueError(f"substeps must be >= 1, got {substeps}")
         key = (space.shape, space.global_shape, (space.x_init, space.y_init),
-               str(space.dtype), self.offsets, impl,
+               str(space.dtype), self.offsets, impl, substeps,
                tuple(f.fingerprint() for f in self.flows))
         cached = self._step_cache.get(key)
         if cached is not None:
@@ -213,13 +240,18 @@ class Model:
         pallas_steppers = None
         if impl in ("pallas", "auto"):
             rates = self.pallas_rates()
-            eligible = (rates is not None and not space.is_partition)
+            # substeps > 1 fuses steps inside the kernel, so a (local)
+            # point flow — which must fire between sub-steps — disqualifies
+            eligible = (rates is not None and not space.is_partition
+                        and (substeps == 1 or not pt_by_attr))
             if impl == "pallas" and not eligible:
                 raise ValueError(
                     "impl='pallas' requires all field flows to be plain "
-                    "Diffusion and a full (non-partition) grid; got "
+                    "Diffusion and a full (non-partition) grid (and no "
+                    "point flows when substeps > 1); got "
                     f"flows={[type(f).__name__ for f in self.flows]}, "
-                    f"is_partition={space.is_partition}. Use impl='xla' "
+                    f"is_partition={space.is_partition}, "
+                    f"substeps={substeps}. Use impl='xla' "
                     "or 'auto'; for sharded grids use "
                     "ShardMapExecutor(mesh, step_impl='pallas'), which "
                     "runs the fused kernel per shard over the halo ring.")
@@ -228,7 +260,8 @@ class Model:
                 pallas_steppers = {
                     attr: PallasDiffusionStep(space.shape, rate,
                                               dtype=space.dtype,
-                                              offsets=offsets)
+                                              offsets=offsets,
+                                              nsteps=substeps)
                     for attr, rate in rates.items() if rate != 0.0}
             if pallas_steppers is not None and impl == "auto":
                 # Static eligibility can't prove the kernel will actually
@@ -251,7 +284,7 @@ class Model:
         gshape = space.global_shape
         shape = (space.dim_x, space.dim_y)
 
-        def step(values: Values) -> Values:
+        def single(values: Values) -> Values:
             new = dict(values)
             # counts as traced iota arithmetic INSIDE the step: closing
             # over the materialized numpy grid bakes an O(grid) constant
@@ -259,6 +292,9 @@ class Model:
             counts = neighbor_counts_traced(shape, offsets, origin, gshape,
                                             space.dtype)
             if pallas_steppers is not None:
+                # with substeps > 1, each stepper advances ALL the
+                # sub-steps inside the kernel (and eligibility guaranteed
+                # there are no point flows to interleave)
                 for attr, stepper in pallas_steppers.items():
                     new[attr] = stepper(values[attr])
             else:
@@ -276,9 +312,18 @@ class Model:
                                             offsets)
             return new
 
+        if substeps == 1 or pallas_steppers is not None:
+            step = single
+        else:
+            def step(values: Values) -> Values:
+                for _ in range(substeps):
+                    values = single(values)
+                return values
+
         # which field-flow kernel the step actually uses (after any auto
         # fallback) — callers like bench report it
         step.impl = "pallas" if pallas_steppers is not None else "xla"
+        step.substeps = substeps
         self._step_cache[key] = step
         return step
 

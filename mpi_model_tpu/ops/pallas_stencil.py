@@ -90,7 +90,7 @@ def check_offsets(offsets: Sequence[tuple[int, int]]) -> tuple:
 
 
 def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
-                  global_shape):
+                  global_shape, nsteps=1, compute_dtype=jnp.float32):
     """Build and invoke the fused-stencil ``pallas_call``.
 
     Two modes share the window/pipeline machinery:
@@ -110,7 +110,26 @@ def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
       composes with ``shard_map``'s ppermute ring (SURVEY §7 "Pallas at
       16384^2"): ppermute's zero-fill at true grid edges reproduces
       exactly the zero border the dense kernel builds for itself.
+
+    ``nsteps > 1`` (dense mode only): the Mosaic-alignment over-fetch
+    means the window already holds an ``hr``-row / ``hc``-column halo
+    that a single step never consumes — enough ghost depth for
+    ``min(hr, hc)`` steps. The kernel applies the flow update ``nsteps``
+    times to the in-VMEM window on a region that shrinks one ring per
+    step (contamination from the window edge creeps inward one cell per
+    step and never reaches the interior), then writes the (bh, bw)
+    output once — amortizing the HBM round-trip over ``nsteps``
+    cell-updates. Interior tiles run the closed-form uniform-count
+    update; tiles whose influence region touches the global ring run
+    the exact per-cell-count form with an in-grid mask, so boundary
+    behavior composes correctly across the fused steps.
     """
+    if nsteps < 1:
+        raise ValueError(f"nsteps must be >= 1, got {nsteps}")
+    if nsteps > 1 and halo_operands is not None:
+        raise ValueError(
+            "multi-step fusion (nsteps > 1) is dense-mode only: the "
+            "sharded halo ring is one cell deep")
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -128,6 +147,11 @@ def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
     hr = min(SUB, bh)
     hc = min(LANE, bw)
     wh, ww = bh + 2 * hr, bw + 2 * hc  # window shape
+    if nsteps > min(hr, hc):
+        raise ValueError(
+            f"nsteps={nsteps} exceeds the window's ghost depth "
+            f"min(hr={hr}, hc={hc}) for block {(bh, bw)} and dtype "
+            f"{v.dtype}; use nsteps <= {min(hr, hc)} or a larger block")
     if halo:
         n_pieces = 9
     else:
@@ -324,6 +348,93 @@ def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
             return vwin[slot, pl.ds(hr + r, nr), pl.ds(hc + c, nc)].astype(
                 jnp.float32)
 
+        if halo:
+            g_r0 = orig_ref[0] + r0
+            g_c0 = orig_ref[1] + c0
+        else:
+            g_r0 = r0
+            g_c0 = c0
+
+        if nsteps > 1:
+            # ---- multi-step fused path (dense mode only) ----
+            # The DMA-aligned window carries an hr-row / hc-column halo;
+            # only an nsteps-deep ring of it is ever consumed, so the
+            # compute region is first NARROWED to (bh+2n, bw+2n) — the
+            # per-step VPU area is ~1.03x the output tile instead of the
+            # full window's ~1.6x — then the update is applied nsteps
+            # times, the region shrinking one ring per step (after s
+            # steps only cells >= s from the region edge are exact; the
+            # output interior sits exactly nsteps in). One HBM read +
+            # one write buys nsteps cell-updates.
+            MH, MW = bh + 2 * nsteps, bw + 2 * nsteps
+            cdt = compute_dtype
+
+            def mwin():
+                return vwin[slot, pl.ds(hr - nsteps, MH),
+                            pl.ds(hc - nsteps, MW)].astype(cdt)
+
+            # Tiles whose nsteps-deep influence region touches the global
+            # ring take the exact per-cell-count masked form; the rest
+            # take the interior fast path. The branches are mutually
+            # exclusive (pl.when both ways) so edge tiles don't pay for a
+            # fast-path sweep they would immediately overwrite.
+            near = ((g_r0 <= nsteps) | (g_r0 + bh >= H - nsteps)
+                    | (g_c0 <= nsteps) | (g_c0 + bw >= W - nsteps))
+
+            @pl.when(jnp.logical_not(near))
+            def _():
+                cur = mwin()
+                for _ in range(nsteps):
+                    hs, ws = cur.shape
+                    if is_moore:
+                        band = (cur[0:hs - 2, :] + cur[1:hs - 1, :]
+                                + cur[2:hs, :])
+                        nine = (band[:, 0:ws - 2] + band[:, 1:ws - 1]
+                                + band[:, 2:ws])
+                        cur = (cur[1:hs - 1, 1:ws - 1]
+                               * (1.0 - rate - rate / k)
+                               + nine * (rate / k))
+                    else:
+                        g = None
+                        for dx, dy in offsets:
+                            t = cur[1 + dx:hs - 1 + dx, 1 + dy:ws - 1 + dy]
+                            g = t if g is None else g + t
+                        cur = (cur[1:hs - 1, 1:ws - 1] * (1.0 - rate)
+                               + g * (rate / k))
+                out_ref[...] = cur.astype(out_ref.dtype)
+
+            # Exact masked form: share = rate*v/count, recipients outside
+            # the grid masked to zero each step — composing the boundary
+            # behavior correctly across the fused steps (equals nsteps
+            # applications of the single-step kernel).
+            @pl.when(near)
+            def _():
+                row_g = (g_r0 - _i32(nsteps)) + lax.broadcasted_iota(
+                    jnp.int32, (MH, MW), 0)
+                col_g = (g_c0 - _i32(nsteps)) + lax.broadcasted_iota(
+                    jnp.int32, (MH, MW), 1)
+                mask = ((row_g >= 0) & (row_g < H)
+                        & (col_g >= 0) & (col_g < W)).astype(jnp.float32)
+                cnt = jnp.zeros((MH, MW), jnp.float32)
+                for dx, dy in offsets:
+                    ok = ((row_g + _i32(dx) >= 0) & (row_g + _i32(dx) < H)
+                          & (col_g + _i32(dy) >= 0)
+                          & (col_g + _i32(dy) < W))
+                    cnt = cnt + ok.astype(jnp.float32)
+                cnt = jnp.maximum(cnt, 1.0)  # off-grid: v is 0 anyway
+                c2 = mwin() * mask
+                for s in range(nsteps):
+                    hs, ws = c2.shape
+                    share = (rate * c2) / cnt[s:MH - s, s:MW - s]
+                    g = None
+                    for dx, dy in offsets:
+                        t = share[1 + dx:hs - 1 + dx, 1 + dy:ws - 1 + dy]
+                        g = t if g is None else g + t
+                    c2 = ((c2[1:hs - 1, 1:ws - 1] * (1.0 - rate) + g)
+                          * mask[s + 1:MH - s - 1, s + 1:MW - s - 1])
+                out_ref[...] = c2.astype(out_ref.dtype)
+            return
+
         # Fast path, exact in the grid interior where every cell has k
         # neighbors: share = rate*v/k, so
         #   out = (1 - rate - rate/k)*v + (rate/k)*Σ_{3x3}v   (Moore)
@@ -353,12 +464,6 @@ def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
         # not its grid index (a ring-adjacent cell can live in a non-edge
         # tile when bh or bw is 1, or in any tile of a shard that abuts
         # the global boundary).
-        if halo:
-            g_r0 = orig_ref[0] + r0
-            g_c0 = orig_ref[1] + c0
-        else:
-            g_r0 = r0
-            g_c0 = c0
         near_ring = ((g_r0 <= 1) | (g_r0 + bh >= H - 1)
                      | (g_c0 <= 1) | (g_c0 + bw >= W - 1))
 
@@ -422,13 +527,16 @@ def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("rate", "block", "offsets", "interpret"))
+                   static_argnames=("rate", "block", "offsets", "interpret",
+                                    "nsteps", "compute_dtype"))
 def _pallas_step(v: jax.Array, *, rate: float,
                  block: tuple[int, int],
                  offsets: tuple[tuple[int, int], ...],
-                 interpret: bool) -> jax.Array:
+                 interpret: bool, nsteps: int = 1,
+                 compute_dtype=jnp.float32) -> jax.Array:
     return _stencil_call(v, None, rate=rate, block=block, offsets=offsets,
-                         interpret=interpret, global_shape=None)
+                         interpret=interpret, global_shape=None,
+                         nsteps=nsteps, compute_dtype=compute_dtype)
 
 
 @functools.partial(jax.jit,
@@ -547,10 +655,17 @@ def pallas_dense_step(
     offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS,
     block: Optional[tuple[int, int]] = None,
     interpret: Optional[bool] = None,
+    nsteps: int = 1,
+    compute_dtype=None,
 ) -> jax.Array:
-    """One fused dense flow step: every cell sheds ``rate * value`` split
-    equally among its in-bounds neighbors (any radius-1 neighborhood).
-    Drop-in equivalent of ``flow_step(values, rate * ones, counts)``."""
+    """``nsteps`` fused dense flow steps in one HBM round-trip: every
+    cell sheds ``rate * value`` split equally among its in-bounds
+    neighbors (any radius-1 neighborhood), applied ``nsteps`` times
+    entirely in VMEM. With ``nsteps=1``, a drop-in equivalent of
+    ``flow_step(values, rate * ones, counts)``; larger ``nsteps``
+    amortizes the memory traffic over the steps (the HBM-bandwidth
+    lever) and is exact up to the window's ghost depth
+    (``min(sublane, bh)`` rows — 8 f32 / 16 bf16 at default blocks)."""
     offsets = check_offsets(offsets)
     h, w = values.shape
     if interpret is None:
@@ -563,26 +678,36 @@ def pallas_dense_step(
         block = (_pick_block(h, 512, sub), _pick_block(w, 512, LANE))
     else:
         block = _validate_block(h, w, block)
+    if compute_dtype is None:
+        # f32 interior math by default — bf16 grids gain accuracy from
+        # f32 shares; pass compute_dtype=jnp.bfloat16 to trade interior
+        # precision for VPU throughput in the multi-step loop (the
+        # near-ring path always computes in f32)
+        compute_dtype = jnp.float32
     return _pallas_step(values, rate=float(rate),
                         block=tuple(block), offsets=offsets,
-                        interpret=bool(interpret))
+                        interpret=bool(interpret), nsteps=int(nsteps),
+                        compute_dtype=jnp.dtype(compute_dtype))
 
 
 class PallasDiffusionStep:
     """Reusable stepper bound to one grid geometry and rate (for scan
-    bodies / executors)."""
+    bodies / executors). ``nsteps > 1`` makes one call perform that many
+    fused flow steps (see ``pallas_dense_step``)."""
 
     def __init__(self, shape: tuple[int, int], rate: float,
                  dtype=jnp.float32,
                  offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS,
                  block: Optional[tuple[int, int]] = None,
-                 interpret: Optional[bool] = None):
+                 interpret: Optional[bool] = None,
+                 nsteps: int = 1):
         self.shape = shape
         self.rate = float(rate)
         self.offsets = check_offsets(offsets)
         self.block = block
         self.interpret = interpret
+        self.nsteps = int(nsteps)
 
     def __call__(self, values: jax.Array) -> jax.Array:
         return pallas_dense_step(values, self.rate, self.offsets, self.block,
-                                 self.interpret)
+                                 self.interpret, nsteps=self.nsteps)

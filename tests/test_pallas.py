@@ -210,3 +210,107 @@ def test_tpu_hardware_halo_mode():  # pragma: no cover - TPU only
             interpret=False))
         np.testing.assert_allclose(got.astype(np.float64), want,
                                    rtol=1e-5, atol=1e-5)
+
+
+# -- multi-step fusion (nsteps / substeps) -----------------------------------
+
+@pytest.mark.parametrize("shape,block,ns", [
+    ((40, 256), (8, 128), 4),
+    ((40, 640), (8, 128), 4),   # 5x5 tiles: genuine INTERIOR fast path
+    ((64, 256), (16, 128), 8),
+    ((24, 256), (8, 128), 8),   # every tile near the global ring
+    ((16, 128), None, 4),
+    ((13, 160), (13, 32), 4),   # odd rows: boundary masking across steps
+])
+def test_multistep_matches_oracle(shape, block, ns):
+    """nsteps fused steps == nsteps sequential oracle steps, including
+    grid-boundary divisor behavior composed across the fused steps."""
+    v = _grid(*shape)
+    want = v.astype(np.float64)
+    for _ in range(ns):
+        want = dense_flow_step_np(want, 0.13)
+    got = np.asarray(pallas_dense_step(jnp.asarray(v), 0.13, block=block,
+                                       interpret=True, nsteps=ns),
+                     np.float64)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    # mass conserved across the fused steps
+    assert abs(got.sum() - v.astype(np.float64).sum()) < 1e-2
+
+
+def test_multistep_matches_composed_kernel_von_neumann():
+    v = _grid(32, 256)
+    offs = VON_NEUMANN_OFFSETS
+    x = jnp.asarray(v)
+    for _ in range(4):
+        x = pallas_dense_step(x, 0.2, offsets=offs, block=(8, 128),
+                              interpret=True)
+    y = pallas_dense_step(jnp.asarray(v), 0.2, offsets=offs, block=(8, 128),
+                          interpret=True, nsteps=4)
+    np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_nsteps_exceeding_ghost_depth_raises():
+    v = jnp.asarray(_grid(32, 256))
+    with pytest.raises(ValueError, match="ghost depth"):
+        pallas_dense_step(v, 0.1, block=(8, 128), interpret=True, nsteps=9)
+    with pytest.raises(ValueError, match="nsteps"):
+        pallas_dense_step(v, 0.1, interpret=True, nsteps=0)
+
+
+def test_make_step_substeps_pallas_matches_composed_xla():
+    space = CellularSpace.create(32, 256, 1.0, dtype=jnp.float32)
+    space = space.with_values({"value": jnp.asarray(_grid(32, 256))})
+    model = Model(Diffusion(0.12), 8.0, 1.0)
+    sp = model.make_step(space, impl="pallas", substeps=4)
+    assert sp.impl == "pallas" and sp.substeps == 4
+    sx = model.make_step(space, impl="xla")
+    got = sp(dict(space.values))["value"]
+    want = dict(space.values)
+    for _ in range(4):
+        want = sx(want)
+    np.testing.assert_allclose(np.asarray(got, np.float64),
+                               np.asarray(want["value"], np.float64),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_serial_executor_substeps_with_remainder_bitwise():
+    """SerialExecutor(substeps=k) must advance exactly num_steps steps —
+    q fused calls + r singles — bitwise-equal on the XLA path, and with a
+    point flow firing every step."""
+    from mpi_model_tpu import PointFlow
+
+    rng = np.random.default_rng(9)
+    space = CellularSpace.create(24, 40, 1.0, dtype=jnp.float64)
+    space = space.with_values(
+        {"value": jnp.asarray(rng.uniform(0.5, 2.0, (24, 40)))})
+    model = Model([Diffusion(0.1), PointFlow(source=(5, 5), flow_rate=0.3)],
+                  10.0, 1.0)
+    out_a, _ = model.execute(space, SerialExecutor(), steps=10)
+    out_b, _ = model.execute(space, SerialExecutor(substeps=4), steps=10)
+    np.testing.assert_array_equal(np.asarray(out_a.values["value"]),
+                                  np.asarray(out_b.values["value"]))
+
+
+def test_make_step_substeps_pallas_rejects_point_flow():
+    from mpi_model_tpu import PointFlow
+
+    space = CellularSpace.create(32, 256, 1.0, dtype=jnp.float32)
+    model = Model([Diffusion(0.1), PointFlow(source=(3, 3), flow_rate=0.2)],
+                  1.0, 1.0)
+    with pytest.raises(ValueError, match="point flows"):
+        model.make_step(space, impl="pallas", substeps=2)
+
+
+def test_auto_oversized_substeps_falls_back_to_xla():
+    """substeps beyond the window ghost depth: 'auto' degrades to the
+    composed-XLA step instead of raising (the ValueError is caught by the
+    probe, like any other Pallas ineligibility)."""
+    import warnings
+
+    space = CellularSpace.create(32, 256, 1.0, dtype=jnp.float32)
+    model = Model(Diffusion(0.12), 1.0, 1.0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s = model.make_step(space, impl="auto", substeps=200)
+    assert s.impl == "xla" and s.substeps == 200
