@@ -33,15 +33,17 @@ import json
 import sys
 
 
-def validate_on_device(substeps: int, verbose: bool = False) -> float:
-    """Golden-check the step the bench is about to time, on the bench
-    device, against the composed NumPy oracle. The grid is 1536x1536 —
-    3x3 tiles at the default (512,512) block — so GENUINE INTERIOR tiles
-    exercise the multi-step fast path (a single-tile grid would be
-    entirely 'near-ring' and only check the exact masked branch). Runs
-    in f32 (tight tolerance) and in the bench dtype bf16 (storage-
-    rounding tolerance). Returns the worst max-abs-error; raises on
-    mismatch."""
+def validate_on_device(substeps: int, dtype_name: str = "bfloat16",
+                       verbose: bool = False) -> dict:
+    """Golden-check the kernel configuration the bench is about to time,
+    on the bench device, against the composed NumPy oracle. The grid is
+    1536x1536 — 3x3 tiles at the default (512,512) block — so GENUINE
+    INTERIOR tiles exercise the multi-step fast path (a single-tile grid
+    would be entirely 'near-ring' and only check the exact masked
+    branch). Runs in f32 (tight tolerance) and in the bench dtype
+    (storage-rounding tolerance). Returns {dtype_name: impl} of the
+    validated steps so the caller can assert the step it times resolved
+    to the same kernel; raises on mismatch."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -55,26 +57,29 @@ def validate_on_device(substeps: int, verbose: bool = False) -> float:
     for _ in range(max(1, substeps)):
         want = dense_flow_step_np(want, 0.1)
 
-    worst = 0.0
-    for dtype, tol in ((jnp.float32, 1e-5 * max(1, substeps)),
-                       (jnp.bfloat16, 0.04)):
+    names = {"float32": (jnp.float32, 1e-5 * max(1, substeps)),
+             "bfloat16": (jnp.bfloat16, 0.04)}
+    todo = dict(names) if dtype_name in names else {
+        **names, dtype_name: (jnp.dtype(dtype_name).type, 0.04)}
+    impls = {}
+    for name, (dtype, tol) in todo.items():
         space = CellularSpace.create(g, g, 1.0, dtype=dtype)
         space = space.with_values({"value": jnp.asarray(v0, dtype)})
         model = Model(Diffusion(0.1), 1.0, 1.0)
         step = model.make_step(space, impl="auto", substeps=substeps)
         got = np.asarray(step(dict(space.values))["value"], np.float64)
         err = float(np.abs(got - want).max())
-        worst = max(worst, err)
         if err > tol:
             raise AssertionError(
-                f"on-device validation failed ({jnp.dtype(dtype).name}): "
+                f"on-device validation failed ({name}): "
                 f"max|err|={err:.3e} > {tol:.1e} vs the NumPy oracle "
                 f"({substeps} steps, impl={step.impl})")
+        impls[name] = step.impl
         if verbose:
-            print(f"  on-device validation OK ({jnp.dtype(dtype).name}): "
+            print(f"  on-device validation OK ({name}): "
                   f"max|err|={err:.2e} (impl={step.impl}, "
                   f"substeps={substeps})", file=sys.stderr)
-    return worst
+    return impls
 
 
 def bench(grid: int = 16384, dtype_name: str = "bfloat16",
@@ -84,7 +89,7 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
     from mpi_model_tpu import CellularSpace, Diffusion, Model
     from mpi_model_tpu.utils import marginal_step_time
 
-    validate_on_device(substeps, verbose=verbose)
+    validated = validate_on_device(substeps, dtype_name, verbose=verbose)
 
     dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
     space = CellularSpace.create(grid, grid, 1.0, dtype=dtype)
@@ -95,6 +100,14 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
     # inside the framework if the kernel fails to compile
     step = model.make_step(space, impl="auto", substeps=substeps)
     impl_used = step.impl
+    if impl_used != validated[dtype_name]:
+        # "auto" resolves per geometry: the validated kernel and the
+        # timed kernel must be the same implementation, or the gate
+        # proved nothing about what we are about to time
+        raise AssertionError(
+            f"impl mismatch: validated {validated[dtype_name]!r} at "
+            f"1536^2 but the {grid}^2 bench step resolved to "
+            f"{impl_used!r}")
     t = marginal_step_time(step, dict(space.values), s1=10, s2=60, reps=3)
 
     cups = grid * grid * substeps / t

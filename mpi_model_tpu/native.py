@@ -61,6 +61,22 @@ def load_library():
     if _lib is not None:
         return _lib
     lib = ctypes.CDLL(build_native())
+    if not hasattr(lib, "mmtpu_selftest_recv_timeout"):
+        # stale libmmtpu.so from an older source tree: rebuild, then load
+        # the fresh binary under a UNIQUE path — dlopen would hand back
+        # the already-mapped stale object for the original path
+        import shutil
+        import tempfile
+
+        build_native(force=True)
+        fd, tmp = tempfile.mkstemp(suffix=".so")
+        os.close(fd)
+        shutil.copy2(_LIB_PATH, tmp)
+        lib = ctypes.CDLL(tmp)
+        if not hasattr(lib, "mmtpu_selftest_recv_timeout"):
+            raise RuntimeError(
+                "libmmtpu.so is stale and rebuilding did not refresh it; "
+                "remove native/build and retry")
     lib.mmtpu_last_error.restype = ctypes.c_char_p
     lib.mmtpu_abi_version.restype = ctypes.c_int
     lib.mmtpu_dtype_tag_float64.restype = ctypes.c_int
@@ -87,10 +103,25 @@ def load_library():
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_double, ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double)]
+    lib.mmtpu_selftest_recv_timeout.restype = ctypes.c_int
+    lib.mmtpu_selftest_recv_timeout.argtypes = [ctypes.c_int]
     # ABI pin: the native dtype tags must match abstraction.DataType.
     assert lib.mmtpu_dtype_tag_float64() == to_native(DataType.FLOAT64)
     _lib = lib
     return lib
+
+
+def selftest_recv_timeout(timeout_ms: int = 100) -> bool:
+    """Drive the native runtime's failure-detection path: a bounded recv
+    on a rank that will never be sent to must raise RecvTimeout inside
+    the engine (returned here as True). The reference in the same
+    situation hangs forever (SURVEY §5: 'a failed rank = hung job')."""
+    rc = load_library().mmtpu_selftest_recv_timeout(int(timeout_ms))
+    if rc == -1:
+        raise RuntimeError(
+            f"native selftest errored: "
+            f"{load_library().mmtpu_last_error().decode()}")
+    return rc == 1
 
 
 def _flow_specs(flows) -> tuple:

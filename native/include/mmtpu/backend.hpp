@@ -15,12 +15,14 @@
 //   the driver reaches it by embedding CPython (see src/main.cpp).
 #pragma once
 
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <deque>
 #include <memory>
 #include <mutex>
 #include <stdexcept>
+#include <string>
 #include <vector>
 
 namespace mmtpu {
@@ -29,6 +31,15 @@ struct Message {
   int src = 0;
   int tag = 0;
   std::vector<double> payload;
+};
+
+// A blocking receive gave up waiting: the failure-DETECTION signal the
+// reference lacks entirely (SURVEY §5: live code ignores MPI return
+// codes; "a failed rank = hung job"). A dead or deadlocked peer now
+// surfaces as a diagnosable exception instead of an eternal hang.
+class RecvTimeout : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
 };
 
 // Per-rank inbox with MPI-like matching on (src, tag).
@@ -43,8 +54,13 @@ class Mailbox {
   }
 
   // Blocking receive of the first message matching (src, tag).
-  std::vector<double> recv(int src, int tag) {
+  // timeout_ms == 0 waits forever (the reference's MPI_Recv semantics);
+  // otherwise throws RecvTimeout once the deadline passes.
+  std::vector<double> recv(int src, int tag, long timeout_ms = 0) {
     std::unique_lock<std::mutex> lk(mu_);
+    const auto deadline = std::chrono::steady_clock::now() +
+                          std::chrono::milliseconds(timeout_ms);
+    bool expired = false;
     for (;;) {
       for (auto it = box_.begin(); it != box_.end(); ++it) {
         if (it->src == src && it->tag == tag) {
@@ -53,7 +69,21 @@ class Mailbox {
           return out;
         }
       }
-      cv_.wait(lk);
+      if (expired) {
+        // the scan above ran once more after the deadline, so a message
+        // arriving exactly at expiry is still delivered, not dropped
+        throw RecvTimeout(
+            "recv timeout after " + std::to_string(timeout_ms) +
+            "ms waiting for message (src=" + std::to_string(src) +
+            ", tag=" + std::to_string(tag) +
+            ") — peer rank dead or deadlocked");
+      }
+      if (timeout_ms <= 0) {
+        cv_.wait(lk);
+      } else {
+        expired =
+            cv_.wait_until(lk, deadline) == std::cv_status::timeout;
+      }
     }
   }
 
@@ -66,11 +96,16 @@ class Mailbox {
 // A set of ranks wired all-to-all: the communicator.
 class ThreadComm {
  public:
-  explicit ThreadComm(int size) : boxes_(size) {
+  // recv_timeout_ms bounds every blocking receive (default 60s): a lost
+  // rank fails the job with a RecvTimeout naming the missing (src, tag)
+  // instead of hanging it. 0 restores unbounded reference semantics.
+  explicit ThreadComm(int size, long recv_timeout_ms = 60000)
+      : boxes_(size), recv_timeout_ms_(recv_timeout_ms) {
     for (auto& b : boxes_) b = std::make_unique<Mailbox>();
   }
 
   int size() const { return static_cast<int>(boxes_.size()); }
+  long recv_timeout_ms() const { return recv_timeout_ms_; }
 
   // Blocking typed send/recv (the reference's Send<T>/Receive<T> wrappers,
   // MPIImpl.hpp:30-38, fixed to actually be used by the runtime).
@@ -81,11 +116,12 @@ class ThreadComm {
 
   std::vector<double> recv(int src, int dst, int tag) {
     if (dst < 0 || dst >= size()) throw std::out_of_range("bad dst rank");
-    return boxes_[dst]->recv(src, tag);
+    return boxes_[dst]->recv(src, tag, recv_timeout_ms_);
   }
 
  private:
   std::vector<std::unique_ptr<Mailbox>> boxes_;
+  long recv_timeout_ms_;
 };
 
 }  // namespace mmtpu

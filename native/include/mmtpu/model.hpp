@@ -109,9 +109,13 @@ class Model {
   // Threaded-rank execution: n = lines*columns workers, 2-D block
   // decomposition (lines=1 → the reference's 1-D striping), two-stage
   // corner-complete halo exchange each step, tree-free rank-0 reduction.
+  // halo_timeout_ms bounds every halo receive (failure detection: a dead
+  // rank raises RecvTimeout instead of hanging the job); 0 restores the
+  // reference's unbounded MPI_Recv semantics.
   Report execute_threaded(CellularSpace& cs, int lines, int columns,
                           int steps = -1, bool check_conservation = true,
-                          double tolerance = 1e-3) const {
+                          double tolerance = 1e-3,
+                          long halo_timeout_ms = 60000) const {
     const int n = lines * columns;
     Report rep;
     rep.comm_size = n;
@@ -119,7 +123,7 @@ class Model {
     rep.initial_total = total_all(cs);
 
     auto parts = block_partitions(cs.dim_x(), cs.dim_y(), lines, columns);
-    ThreadComm comm(n);
+    ThreadComm comm(n, halo_timeout_ms);
     std::vector<CellularSpace> locals;
     locals.reserve(n);
     for (const auto& p : parts) locals.push_back(cs.slice(p));

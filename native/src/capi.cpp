@@ -12,6 +12,7 @@
 #include <vector>
 
 #include "mmtpu/abstraction.hpp"
+#include "mmtpu/backend.hpp"
 #include "mmtpu/cellular_space.hpp"
 #include "mmtpu/flow.hpp"
 #include "mmtpu/model.hpp"
@@ -43,6 +44,24 @@ typedef struct {
 const char* mmtpu_last_error() { return g_last_error.c_str(); }
 
 int mmtpu_abi_version() { return 1; }
+
+// Failure-detection self-test: a 2-rank comm where rank 1 never sends —
+// the bounded recv must surface RecvTimeout (the hang the reference's
+// unmatched sends produce, ModelRectangular.hpp:199-220, turned into a
+// detectable failure). Returns 1 if the timeout fired, 0 if the recv
+// returned (impossible), -1 on any other error.
+int mmtpu_selftest_recv_timeout(int timeout_ms) {
+  try {
+    ThreadComm comm(2, timeout_ms);
+    (void)comm.recv(/*src=*/1, /*dst=*/0, /*tag=*/7);
+    return 0;
+  } catch (const RecvTimeout&) {
+    return 1;
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
 
 // ABI pin for the dtype tags shared with mpi_model_tpu/abstraction.py.
 int mmtpu_dtype_tag_float64() {

@@ -42,6 +42,7 @@ struct Args {
   bool use_time_loop = false;  // --time-loop: steps = time/time_step
   std::string output;  // optional output dir
   int workers = 4;
+  long halo_timeout_ms = 60000;  // 0 = unbounded (reference semantics)
 };
 
 Args parse(int argc, char** argv) {
@@ -71,6 +72,7 @@ Args parse(int argc, char** argv) {
     else if (eat("--time", &v)) { a.time = std::stod(v); a.use_time_loop = true; }
     else if (eat("--time-step", &v)) { a.time_step = std::stod(v); a.use_time_loop = true; }
     else if (eat("--flow", &v)) a.dense = (v == "diffusion");
+    else if (eat("--halo-timeout-ms", &v)) a.halo_timeout_ms = std::stol(v);
     else if (eat("--output", &v)) a.output = v;
     else if (s == "--help" || s == "-h") {
       std::cout <<
@@ -78,7 +80,8 @@ Args parse(int argc, char** argv) {
         "           [--steps=N | --time=T --time-step=DT]\n"
         "           [--source=x,y --rate=R --value=V --init=I]\n"
         "           [--flow=exponencial|diffusion]\n"
-        "           [--lines=L --columns=C | --workers=N] [--output=DIR]\n";
+        "           [--lines=L --columns=C | --workers=N] [--output=DIR]\n"
+        "           [--halo-timeout-ms=MS]  (0 = unbounded recv)\n";
       exit(0);
     } else {
       std::cerr << "unknown flag: " << s << "\n";
@@ -139,7 +142,9 @@ int run_native(const Args& a, bool threaded) {
 
   try {
     Report rep = threaded
-                     ? model.execute_threaded(cs, lines, columns, steps)
+                     ? model.execute_threaded(cs, lines, columns, steps,
+                                              /*check=*/true, 1e-3,
+                                              a.halo_timeout_ms)
                      : model.execute(cs, steps);
     std::cout << "backend=" << (threaded ? "threads" : "native")
               << " ranks=" << rep.comm_size << " steps=" << rep.steps
