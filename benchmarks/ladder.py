@@ -114,9 +114,12 @@ def tpu_serial_cups(grid: int, dtype_name: str, flows, impl: str = "auto",
 
 def sharded_cups_and_halo(grid: int, mesh_shape: tuple, dtype_name: str,
                           flows, step_impl: str = "xla",
-                          s1: int = 5, s2: int = 25, reps: int = 2) -> dict:
+                          s1: int = 5, s2: int = 25, reps: int = 2,
+                          halo_depth: int = 1) -> dict:
     """Sharded step on an n-device mesh: cell-updates/sec with real halo
-    exchange, plus the halo wallclock share (see module docstring)."""
+    exchange, plus the halo wallclock share (see module docstring).
+    ``halo_depth > 1`` measures the deep-halo executor (one depth-d
+    exchange per d steps)."""
     import jax
     import jax.numpy as jnp
 
@@ -145,7 +148,8 @@ def sharded_cups_and_halo(grid: int, mesh_shape: tuple, dtype_name: str,
     with jax.default_device(cpus[0]):
         times = {}
         for mode in ("exchange", "zero"):
-            ex = ShardMapExecutor(mesh, step_impl=step_impl, halo_mode=mode)
+            ex = ShardMapExecutor(mesh, step_impl=step_impl, halo_mode=mode,
+                                  halo_depth=halo_depth)
             model = Model(list(flows), 1.0, 1.0)
 
             def run(steps: int):
@@ -206,18 +210,25 @@ def config2(quick: bool = False) -> dict:
 
 
 def config3(quick: bool = False) -> dict:
-    """4096^2 dense Diffusion, 2-D block decomposition, corner halo."""
+    """4096^2 dense Diffusion, 2-D block decomposition, corner halo;
+    plus the deep-halo executor (one depth-4 exchange per 4 steps)."""
     from mpi_model_tpu import Diffusion
 
     g = 64 if quick else 4096
     r = sharded_cups_and_halo(g, (2, 4), "float32", [Diffusion(0.1)],
                               s1=10, s2=60, reps=3)
+    deep = sharded_cups_and_halo(g, (2, 4), "float32", [Diffusion(0.1)],
+                                 s1=10, s2=60, reps=3, halo_depth=4)
     serial = tpu_serial_cups(g, "float32", [Diffusion(0.1)],
                              s1=50, s2=550 if not quick else 250)
     return {
         "config": 3, "grid": g, "flow": "diffusion",
         "strategy": "2-D blocks 2x4 (virtual CPU mesh) + serial TPU",
         "framework_cups": r["cups"], "halo_share": r["halo_share"],
+        "deep_halo_cups": deep["cups"], "deep_halo_share":
+            deep["halo_share"],
+        "deep_halo_speedup": (deep["cups"] / r["cups"]
+                              if r["cups"] and deep["cups"] else None),
         "tpu_serial_cups": serial["cups"], "tpu_impl": serial["impl"],
     }
 

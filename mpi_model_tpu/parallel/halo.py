@@ -38,38 +38,47 @@ def _bwd_perm(n: int) -> list[tuple[int, int]]:
 
 
 def exchange_halo_1d(local: jax.Array, axis_name: str, axis_size: int,
-                     axis: int = 0) -> tuple[jax.Array, jax.Array]:
+                     axis: int = 0, depth: int = 1
+                     ) -> tuple[jax.Array, jax.Array]:
     """Return (before_halo, after_halo) slabs for a 1-D sharded dimension.
 
-    ``before_halo`` is the neighbor-below's last slab (what the reference's
-    rank r receives from r-1), ``after_halo`` the neighbor-above's first.
-    Edge shards receive zeros (non-periodic grid).
+    ``before_halo`` is the neighbor-below's last ``depth`` rows (what the
+    reference's rank r receives from r-1), ``after_halo`` the
+    neighbor-above's first ``depth``. Edge shards receive zeros
+    (non-periodic grid). ``depth > 1`` is the deep-halo exchange: one
+    collective round supplies enough ghost cells for ``depth`` local
+    steps (see ``ShardMapExecutor(halo_depth=...)``).
     """
     n = axis_size
-    last = lax.slice_in_dim(local, local.shape[axis] - 1, local.shape[axis], axis=axis)
-    first = lax.slice_in_dim(local, 0, 1, axis=axis)
+    sz = local.shape[axis]
+    last = lax.slice_in_dim(local, sz - depth, sz, axis=axis)
+    first = lax.slice_in_dim(local, 0, depth, axis=axis)
     before = lax.ppermute(last, axis_name, _fwd_perm(n))
     after = lax.ppermute(first, axis_name, _bwd_perm(n))
     return before, after
 
 
-def pad_with_halo_1d(local: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
-    """[h, w] shard → [h+2, w+2]: rows exchanged with mesh neighbors via
-    ppermute, columns zero-padded (unsharded dimension)."""
-    before, after = exchange_halo_1d(local, axis_name, axis_size, axis=0)
+def pad_with_halo_1d(local: jax.Array, axis_name: str, axis_size: int,
+                     depth: int = 1) -> jax.Array:
+    """[h, w] shard → [h+2d, w+2d]: row slabs exchanged with mesh
+    neighbors via ppermute, columns zero-padded (unsharded dimension)."""
+    before, after = exchange_halo_1d(local, axis_name, axis_size, axis=0,
+                                     depth=depth)
     padded_rows = jnp.concatenate([before, local, after], axis=0)
-    return jnp.pad(padded_rows, ((0, 0), (1, 1)))
+    return jnp.pad(padded_rows, ((0, 0), (depth, depth)))
 
 
 def pad_with_halo_2d(local: jax.Array, ax_name: str, ay_name: str,
-                     nx: int, ny: int) -> jax.Array:
-    """[h, w] shard → [h+2, w+2] with a full 8-neighbor (edge + corner)
-    halo from the 2-D mesh: columns along ``ay`` first, then rows of the
-    augmented array along ``ax`` so corners ride along."""
-    left, right = exchange_halo_1d(local, ay_name, ny, axis=1)
-    aug = jnp.concatenate([left, local, right], axis=1)            # [h, w+2]
-    top, bottom = exchange_halo_1d(aug, ax_name, nx, axis=0)       # [1, w+2]
-    return jnp.concatenate([top, aug, bottom], axis=0)             # [h+2, w+2]
+                     nx: int, ny: int, depth: int = 1) -> jax.Array:
+    """[h, w] shard → [h+2d, w+2d] with a full 8-neighbor (edge + corner)
+    halo from the 2-D mesh: column slabs along ``ay`` first, then row
+    slabs of the augmented array along ``ax`` so the d×d corner blocks
+    ride along."""
+    left, right = exchange_halo_1d(local, ay_name, ny, axis=1, depth=depth)
+    aug = jnp.concatenate([left, local, right], axis=1)          # [h, w+2d]
+    top, bottom = exchange_halo_1d(aug, ax_name, nx, axis=0,     # [d, w+2d]
+                                   depth=depth)
+    return jnp.concatenate([top, aug, bottom], axis=0)           # [h+2d, w+2d]
 
 
 def exchange_ring(local: jax.Array, ax_name: str, nx: int,
