@@ -1,0 +1,262 @@
+"""Command-line driver: the Python counterpart of the reference's
+``Main.cpp`` (and of ``native/src/main.cpp``).
+
+The reference hardcodes everything at compile time — a 100x100 grid, an
+``Exponencial`` flow at cell (19,3) with snapshot value 2.2 and rate 0.1,
+``Model(…, 10.0, 0.2)``, 6 mpirun ranks (``/root/reference/src/Main.cpp:
+17-52``, ``Defines.hpp:5-13``) — and accepts but ignores ``argv``. Here
+the same scenario is the DEFAULT of a real flag surface:
+
+    python -m mpi_model_tpu.cli run                       # the reference run
+    python -m mpi_model_tpu.cli run --flow=diffusion --dimx=1024 \\
+        --mesh=2x4 --halo-depth=4 --steps=100             # sharded
+    python -m mpi_model_tpu.cli run --checkpoint-dir=ckpts \\
+        --checkpoint-every=10 --steps=100                 # supervised+resumable
+    python -m mpi_model_tpu.cli info                      # devices/backends
+
+``run`` wires the whole framework: Model/flows, serial or shard_map
+executors (with multi-step fusion and deep halos), the resilience
+supervisor when checkpointing is on, the reference-parity output dump
+(``--output``), and Chrome-trace export (``--trace``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+
+def _build_model(args):
+    import jax.numpy as jnp
+
+    from . import (
+        Attribute, Cell, CellularSpace, Diffusion, Exponencial, Model,
+    )
+
+    dtype = {"float32": jnp.float32, "float64": jnp.float64,
+             "bfloat16": jnp.bfloat16}[args.dtype]
+    space = CellularSpace.create(args.dimx, args.dimy, args.init,
+                                 dtype=dtype)
+    if args.flow == "exponencial":
+        sx, sy = (int(v) for v in args.source.split(","))
+        flow = Exponencial(Cell(sx, sy, Attribute(99, args.value)),
+                           args.rate)
+    elif args.flow == "diffusion":
+        flow = Diffusion(args.rate)
+    else:
+        raise SystemExit(f"unknown --flow={args.flow!r} "
+                         "(expected exponencial|diffusion)")
+    model = Model(flow, args.time, args.time_step)
+    return space, model
+
+
+def _build_executor(args):
+    if args.mesh is None:
+        from .models.model import SerialExecutor
+
+        return SerialExecutor(step_impl=args.impl, substeps=args.substeps)
+
+    import jax
+
+    from .parallel import ShardMapExecutor, make_mesh, make_mesh_2d
+
+    lines, columns = (int(v) for v in args.mesh.lower().split("x"))
+    n = lines * columns
+    devices = jax.devices()
+    if len(devices) < n:
+        cpus = jax.devices("cpu")
+        if len(cpus) >= n:
+            devices = cpus
+        else:
+            raise SystemExit(
+                f"--mesh={args.mesh} needs {n} devices; have "
+                f"{len(devices)} (hint: XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={n} "
+                "JAX_PLATFORMS=cpu for a virtual mesh)")
+    if lines == 1 or columns == 1:
+        mesh = make_mesh(n, devices=devices[:n])
+    else:
+        mesh = make_mesh_2d(lines, columns, devices=devices[:n])
+    return ShardMapExecutor(mesh, step_impl=args.impl,
+                            halo_depth=args.halo_depth)
+
+
+def cmd_run(args) -> int:
+    import time as _time
+
+    from .utils.tracing import get_tracer
+
+    # inapplicable flag combinations are errors, not silent no-ops — a
+    # user must not believe they benchmarked a configuration that never
+    # ran
+    if args.mesh is None and args.halo_depth != 1:
+        raise SystemExit(
+            "--halo-depth applies to sharded execution; add --mesh=LxC")
+    if args.mesh is not None and args.substeps != 1:
+        raise SystemExit(
+            "--substeps applies to the serial executor; with --mesh use "
+            "--halo-depth for the analogous fusion")
+
+    space, model = _build_model(args)
+    executor = _build_executor(args)
+    steps = args.steps if args.steps is not None else model.num_steps
+    initial = {k: float(space.total(k)) for k in space.values}
+
+    t0 = _time.perf_counter()
+    events = []
+    failure = None
+    out = None
+    ranks = getattr(executor, "comm_size", 1)
+    if args.checkpoint_dir:
+        from .io import CheckpointManager
+        from .resilience import SimulationFailure, supervised_run
+
+        try:
+            res = supervised_run(
+                model, space, CheckpointManager(args.checkpoint_dir),
+                steps=steps, every=args.checkpoint_every,
+                max_failures=args.max_failures, executor=executor,
+                on_event=events.append)
+        except SimulationFailure as e:
+            failure = str(e)
+            events = e.events
+        else:
+            out = res.space
+            # run-global baseline: survives resume via the checkpoint
+            initial = res.initial_totals or initial
+    else:
+        # conservation judged HERE (status line + exit code), not raised
+        # mid-flight — the CLI's contract is a conserved=false record
+        out, report = model.execute(space, executor, steps=steps,
+                                    check_conservation=False)
+        ranks = report.comm_size
+    wall = _time.perf_counter() - t0
+
+    if failure is not None:
+        result = {"backend": "sharded" if args.mesh else "serial",
+                  "ranks": ranks, "steps": steps, "conserved": False,
+                  "error": failure, "recovered_failures": len(events),
+                  "wall_s": wall}
+        print(json.dumps(result) if args.json
+              else f"FAILED after {len(events)} failure(s): {failure}")
+        return 1
+
+    if args.output:
+        from .io import write_output
+
+        merged = write_output(args.output, out, comm_size=max(ranks, 1))
+        print(f"output written to {merged}", file=sys.stderr)
+    if args.trace:
+        get_tracer().export_chrome(args.trace)
+        print(f"trace written to {args.trace}", file=sys.stderr)
+
+    # full-run drift against the run-global initial totals (a per-chunk
+    # report would understate drift on checkpointed runs)
+    final = {k: float(out.total(k)) for k in out.values}
+    err = max(abs(final[k] - initial[k]) for k in initial)
+    thresh = model.conservation_threshold(space, initial_totals=initial)
+    result = {
+        "backend": "sharded" if args.mesh else "serial",
+        "ranks": ranks,
+        "steps": steps,
+        "initial": initial,
+        "final": final,
+        "conservation_error": err,
+        "conserved": bool(err <= thresh),
+        "recovered_failures": len(events),
+        "wall_s": wall,
+    }
+    if args.json:
+        print(json.dumps(result, allow_nan=False))
+    else:
+        status = "CONSERVED" if result["conserved"] else "VIOLATED"
+        print(f"backend={result['backend']} ranks={result['ranks']} "
+              f"steps={steps} initial={result['initial']} "
+              f"final={result['final']} |delta|={err:.3e} {status} "
+              f"({wall:.2f}s, {len(events)} recovered failures)")
+    return 0 if result["conserved"] else 1
+
+
+def cmd_info(args) -> int:
+    import jax
+
+    from . import __version__
+
+    info = {
+        "version": __version__,
+        "jax_backend": jax.default_backend(),
+        "devices": [f"{d.platform}:{d.id}" for d in jax.devices()],
+        "cpu_devices": len(jax.devices("cpu")),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+    }
+    try:
+        from .native import build_native
+
+        info["native_library"] = build_native()
+    except Exception as e:  # toolchain optional
+        info["native_library"] = f"unavailable: {e}"
+    print(json.dumps(info, indent=2 if not args.json else None))
+    return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mpi_model_tpu.cli",
+        description=__doc__.split("\n\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="run a simulation (reference "
+                         "scenario by default)")
+    # reference defaults: Main.cpp:25,32-33 / Defines.hpp:5-6
+    run.add_argument("--dimx", type=int, default=100)
+    run.add_argument("--dimy", type=int, default=100)
+    run.add_argument("--init", type=float, default=1.0)
+    run.add_argument("--flow", default="exponencial",
+                     choices=["exponencial", "diffusion"])
+    run.add_argument("--source", default="19,3",
+                     help="point-flow source cell x,y")
+    run.add_argument("--rate", type=float, default=0.1)
+    run.add_argument("--value", type=float, default=2.2,
+                     help="frozen snapshot value of the point source")
+    run.add_argument("--time", type=float, default=10.0)
+    run.add_argument("--time-step", type=float, default=0.2)
+    run.add_argument("--steps", type=int, default=1,
+                     help="step count (default 1 = the reference's live "
+                     "behavior; pass --steps=-1 for time/time_step)")
+    run.add_argument("--dtype", default="float32",
+                     choices=["float32", "float64", "bfloat16"])
+    run.add_argument("--impl", default="auto",
+                     choices=["xla", "pallas", "auto"])
+    run.add_argument("--substeps", type=int, default=1,
+                     help="fused steps per compiled call (serial executor)")
+    run.add_argument("--mesh", default=None,
+                     help="LxC device mesh for sharded execution "
+                     "(e.g. 4x1, 2x4); omit for serial")
+    run.add_argument("--halo-depth", type=int, default=1,
+                     help="ghost-ring depth d: one exchange per d steps")
+    run.add_argument("--checkpoint-dir", default=None)
+    run.add_argument("--checkpoint-every", type=int, default=1)
+    run.add_argument("--max-failures", type=int, default=3)
+    run.add_argument("--output", default=None,
+                     help="write the reference-parity per-rank dump + "
+                     "merged output file to this directory")
+    run.add_argument("--trace", default=None,
+                     help="write a Chrome trace of the run's phases")
+    run.add_argument("--json", action="store_true")
+    run.set_defaults(fn=cmd_run)
+
+    info = sub.add_parser("info", help="print device/backend info")
+    info.add_argument("--json", action="store_true")
+    info.set_defaults(fn=cmd_info)
+
+    args = ap.parse_args(argv)
+    if getattr(args, "steps", None) == -1:
+        args.steps = None
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

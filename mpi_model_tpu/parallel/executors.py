@@ -201,7 +201,11 @@ class ShardMapExecutor:
 
     def run_model(self, model, space: CellularSpace, num_steps: int) -> Values:
         _check_divisible(space, self.mesh)
-        key = (space.shape, space.global_shape, str(space.dtype),
+        # origin is part of the identity: the compiled runners bake
+        # row0/col0 and the boundary mask from it, so two same-shaped
+        # partitions at different origins must not share a runner
+        key = (space.shape, space.global_shape,
+               (space.x_init, space.y_init), str(space.dtype),
                tuple(space.values), model.offsets, num_steps,
                tuple(f.fingerprint() for f in model.flows))
         spec = grid_spec(self.mesh)

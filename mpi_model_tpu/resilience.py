@@ -90,8 +90,15 @@ class SupervisedResult:
 
     space: CellularSpace
     step: int
+    #: the LAST chunk's report; None when a resumed run was already at
+    #: the requested step count (use ``initial_totals`` + the space for
+    #: run-global accounting)
     report: Optional[Report]
     events: list[FailureEvent]
+    #: the run-global conservation baseline (from the first chunk or the
+    #: resumed checkpoint's extra)
+    initial_totals: dict[str, float] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def recovered_failures(self) -> int:
@@ -249,4 +256,5 @@ def supervised_run(
                          extra={"initial_totals": initial})
 
     return SupervisedResult(space=good_space, step=good_step,
-                            report=report, events=events)
+                            report=report, events=events,
+                            initial_totals=initial)

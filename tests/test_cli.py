@@ -1,0 +1,121 @@
+"""CLI driver (python -m mpi_model_tpu.cli): the Python counterpart of
+the reference's Main.cpp. Runs in-process via cli.main(argv) under the
+8-virtual-CPU rig."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mpi_model_tpu import cli
+
+
+def run_cli(capsys, *argv):
+    rc = cli.main(list(argv))
+    out = capsys.readouterr()
+    return rc, out.out, out.err
+
+
+def test_reference_default_run(capsys):
+    """Bare `run` reproduces the reference scenario: 100x100 grid of 1.0,
+    Exponencial at (19,3), one step, sum conserved at 10000."""
+    rc, out, _ = run_cli(capsys, "run", "--dtype=float64", "--json")
+    assert rc == 0
+    row = json.loads(out)
+    assert row["conserved"] is True
+    assert row["steps"] == 1
+    assert abs(row["initial"]["value"] - 10000.0) < 1e-9
+    assert abs(row["final"]["value"] - 10000.0) < 1e-6
+
+
+def test_time_loop_steps(capsys):
+    rc, out, _ = run_cli(capsys, "run", "--steps=-1", "--dtype=float64",
+                         "--json")
+    assert rc == 0
+    assert json.loads(out)["steps"] == 50  # time 10.0 / time_step 0.2
+
+
+def test_sharded_run_with_deep_halo(capsys, eight_devices):
+    rc, out, _ = run_cli(capsys, "run", "--flow=diffusion", "--dimx=32",
+                         "--dimy=32", "--steps=8", "--mesh=4x1",
+                         "--halo-depth=4", "--dtype=float64", "--json")
+    assert rc == 0
+    row = json.loads(out)
+    assert row["backend"] == "sharded" and row["ranks"] == 4
+    assert row["conserved"] is True
+
+
+def test_checkpointed_run_resumes(tmp_path, capsys):
+    d = str(tmp_path / "ckpts")
+    rc, out, _ = run_cli(capsys, "run", "--flow=diffusion", "--dimx=16",
+                         "--dimy=16", "--steps=6", "--checkpoint-every=2",
+                         f"--checkpoint-dir={d}", "--dtype=float64",
+                         "--json")
+    assert rc == 0
+    assert os.listdir(d)  # checkpoints written
+    # rerun with more steps: resumes from the latest checkpoint
+    rc, out, _ = run_cli(capsys, "run", "--flow=diffusion", "--dimx=16",
+                         "--dimy=16", "--steps=10", "--checkpoint-every=2",
+                         f"--checkpoint-dir={d}", "--dtype=float64",
+                         "--json")
+    assert rc == 0
+    assert json.loads(out)["conserved"] is True
+
+
+def test_output_and_trace_files(tmp_path, capsys):
+    outdir = str(tmp_path / "out")
+    trace = str(tmp_path / "trace.json")
+    rc, _, err = run_cli(capsys, "run", "--dimx=16", "--dimy=16",
+                         "--dtype=float64", f"--output={outdir}",
+                         f"--trace={trace}", "--json")
+    assert rc == 0
+    assert any(f.startswith("comm_rank") for f in os.listdir(outdir))
+    with open(trace) as f:
+        assert json.load(f)["traceEvents"]
+    assert "output written" in err and "trace written" in err
+
+
+def test_human_readable_output(capsys):
+    rc, out, _ = run_cli(capsys, "run", "--dimx=16", "--dimy=16",
+                         "--dtype=float64")
+    assert rc == 0
+    assert "CONSERVED" in out and "backend=serial" in out
+
+
+def test_info(capsys):
+    rc, out, _ = run_cli(capsys, "info")
+    assert rc == 0
+    info = json.loads(out)
+    assert info["cpu_devices"] >= 8
+    assert "version" in info
+
+
+def test_bad_flow_rejected(capsys):
+    with pytest.raises(SystemExit):
+        cli.main(["run", "--flow=bogus"])
+
+
+def test_resumed_complete_run_is_not_a_failure(tmp_path, capsys):
+    """Re-invoking a checkpointed run that already reached the requested
+    step count must report conserved success (run-global baseline from
+    the checkpoint), not NaN/failure."""
+    d = str(tmp_path / "ckpts")
+    args = ["run", "--flow=diffusion", "--dimx=16", "--dimy=16",
+            "--steps=6", "--checkpoint-every=2", f"--checkpoint-dir={d}",
+            "--dtype=float64", "--json"]
+    assert cli.main(list(args)) == 0
+    capsys.readouterr()
+    rc = cli.main(list(args))  # resumes at step 6: loop body never runs
+    out = capsys.readouterr().out
+    assert rc == 0
+    row = json.loads(out)  # strict JSON: no NaN
+    assert row["conserved"] is True
+    assert abs(row["initial"]["value"] - 256.0) < 1e-9
+
+
+def test_inapplicable_flags_rejected(capsys):
+    with pytest.raises(SystemExit, match="--mesh"):
+        cli.main(["run", "--halo-depth=4"])
+    with pytest.raises(SystemExit, match="substeps"):
+        cli.main(["run", "--mesh=4x1", "--substeps=4"])

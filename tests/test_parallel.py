@@ -405,3 +405,21 @@ def test_deep_halo_multi_attribute(mesh2d):
     for k in ("a", "b"):
         np.testing.assert_array_equal(out.to_numpy()[k], want.to_numpy()[k])
     assert rep.conservation_error() < 1e-9
+
+
+def test_runner_cache_keyed_by_origin(mesh1d):
+    """Two same-shaped partitions at different origins must not share a
+    compiled runner (the runner bakes row0/col0 and the boundary mask
+    from the origin at build time)."""
+    rng = np.random.default_rng(3)
+    vals = jnp.asarray(rng.uniform(0.5, 2.0, (16, 48)))
+    model = Model(Diffusion(0.1), 4.0, 1.0)
+    ex = ShardMapExecutor(mesh1d)
+    for x0 in (0, 24):
+        part = CellularSpace.create(
+            16, 48, 1.0, dtype=jnp.float64, x_init=x0, y_init=0,
+            global_dim_x=64, global_dim_y=48).with_values({"value": vals})
+        want, _ = model.execute(part, steps=4, check_conservation=False)
+        got, _ = model.execute(part, ex, steps=4, check_conservation=False)
+        np.testing.assert_array_equal(np.asarray(got.values["value"]),
+                                      np.asarray(want.values["value"]))
