@@ -234,7 +234,8 @@ def config3(quick: bool = False) -> dict:
 
 
 def config4(quick: bool = False) -> dict:
-    """8192^2 multi-attribute, 2 coupled flows, f32 vs bf16."""
+    """8192^2 multi-attribute, coupled flows, f32 vs bf16 — the fused
+    multi-channel FIELD kernel ('auto' selects it; round 3) vs XLA."""
     from mpi_model_tpu import Coupled, Diffusion
 
     g = 64 if quick else 8192
@@ -243,11 +244,15 @@ def config4(quick: bool = False) -> dict:
              Diffusion(0.2, attr="b")]
     f32 = tpu_serial_cups(g, "float32", flows, s1=10, s2=50)
     bf16 = tpu_serial_cups(g, "bfloat16", flows, s1=10, s2=50)
+    xla = tpu_serial_cups(g, "bfloat16", flows, impl="xla", s1=10, s2=50)
     return {
         "config": 4, "grid": g, "flow": "2 coupled + 2 diffusion",
         "strategy": "serial TPU, multi-attribute",
         "f32_cups": f32["cups"], "bf16_cups": bf16["cups"],
         "bf16_speedup": bf16["cups"] / f32["cups"], "impl": f32["impl"],
+        "bf16_xla_cups": xla["cups"],
+        "field_kernel_speedup": (bf16["cups"] / xla["cups"]
+                                 if xla["cups"] else None),
     }
 
 

@@ -238,24 +238,34 @@ class Model:
                 pt_by_attr.setdefault(f.attr, []).append(f)
 
         pallas_steppers = None
+        pallas_field_stepper = None
         if impl in ("pallas", "auto"):
             rates = self.pallas_rates()
+            all_pointwise = all(
+                getattr(f, "footprint", "unknown") == "pointwise"
+                for f in field_flows) and bool(field_flows)
             # substeps > 1 fuses steps inside the kernel, so a (local)
             # point flow — which must fire between sub-steps — disqualifies
-            eligible = (rates is not None and not space.is_partition
-                        and (substeps == 1 or not pt_by_attr))
-            if impl == "pallas" and not eligible:
+            base_ok = (not space.is_partition
+                       and (substeps == 1 or not pt_by_attr))
+            eligible = rates is not None and base_ok
+            field_eligible = all_pointwise and base_ok
+            if impl == "pallas" and not (eligible or field_eligible):
                 raise ValueError(
-                    "impl='pallas' requires all field flows to be plain "
-                    "Diffusion and a full (non-partition) grid (and no "
-                    "point flows when substeps > 1); got "
+                    "impl='pallas' requires all field flows to be "
+                    "POINTWISE (Diffusion/Coupled/...) on a full "
+                    "(non-partition) grid (and no point flows when "
+                    "substeps > 1); got "
                     f"flows={[type(f).__name__ for f in self.flows]}, "
                     f"is_partition={space.is_partition}, "
                     f"substeps={substeps}. Use impl='xla' "
-                    "or 'auto'; for sharded grids use "
-                    "ShardMapExecutor(mesh, step_impl='pallas'), which "
-                    "runs the fused kernel per shard over the halo ring.")
+                    "or 'auto'; for sharded DIFFUSION models use "
+                    "ShardMapExecutor(mesh, step_impl='pallas') — the "
+                    "per-shard halo kernel — or halo_depth>1; other "
+                    "sharded flows run the XLA shard step.")
             if eligible:
+                # every field flow a plain Diffusion: the specialized
+                # kernel with the closed-form interior fast path
                 from ..ops.pallas_stencil import PallasDiffusionStep
                 pallas_steppers = {
                     attr: PallasDiffusionStep(space.shape, rate,
@@ -263,7 +273,16 @@ class Model:
                                               offsets=offsets,
                                               nsteps=substeps)
                     for attr, rate in rates.items() if rate != 0.0}
-            if pallas_steppers is not None and impl == "auto":
+            elif field_eligible:
+                # general pointwise flows (Coupled, user flows): the
+                # multi-channel fused field kernel — every outflow is
+                # evaluated elementwise on the VMEM windows
+                from ..ops.pallas_stencil import PallasFieldStep
+                pallas_field_stepper = PallasFieldStep(
+                    space.shape, field_flows, dtype=space.dtype,
+                    offsets=offsets, nsteps=substeps)
+            if (pallas_steppers is not None
+                    or pallas_field_stepper is not None) and impl == "auto":
                 # Static eligibility can't prove the kernel will actually
                 # compile AND run for this geometry/backend; probe with an
                 # eager step on zeros so "auto" degrades to XLA instead of
@@ -272,14 +291,20 @@ class Model:
                 # eager call also warms _pallas_step's own jit cache and
                 # catches device-side faults, not just compile errors.
                 try:
-                    for s in pallas_steppers.values():
-                        jax.block_until_ready(
-                            s(jnp.zeros(space.shape, space.dtype)))
+                    if pallas_steppers is not None:
+                        for s in pallas_steppers.values():
+                            jax.block_until_ready(
+                                s(jnp.zeros(space.shape, space.dtype)))
+                    else:
+                        zeros = {a: jnp.zeros(space.shape, space.dtype)
+                                 for a in space.values}
+                        jax.block_until_ready(pallas_field_stepper(zeros))
                 except Exception as e:
                     warnings.warn(
                         f"Pallas step failed ({e!r}); impl='auto' falling "
                         "back to the XLA stencil path", RuntimeWarning)
                     pallas_steppers = None
+                    pallas_field_stepper = None
 
         gshape = space.global_shape
         shape = (space.dim_x, space.dim_y)
@@ -297,6 +322,8 @@ class Model:
                 # there are no point flows to interleave)
                 for attr, stepper in pallas_steppers.items():
                     new[attr] = stepper(values[attr])
+            elif pallas_field_stepper is not None:
+                new.update(pallas_field_stepper(values))
             else:
                 outflow = build_outflow(field_flows, values, origin)
                 for attr, o in outflow.items():
@@ -312,7 +339,8 @@ class Model:
                                             offsets)
             return new
 
-        if substeps == 1 or pallas_steppers is not None:
+        if (substeps == 1 or pallas_steppers is not None
+                or pallas_field_stepper is not None):
             step = single
         else:
             def step(values: Values) -> Values:
@@ -322,7 +350,9 @@ class Model:
 
         # which field-flow kernel the step actually uses (after any auto
         # fallback) — callers like bench report it
-        step.impl = "pallas" if pallas_steppers is not None else "xla"
+        step.impl = ("pallas" if (pallas_steppers is not None
+                                  or pallas_field_stepper is not None)
+                     else "xla")
         step.substeps = substeps
         self._step_cache[key] = step
         return step

@@ -711,3 +711,270 @@ class PallasDiffusionStep:
     def __call__(self, values: jax.Array) -> jax.Array:
         return pallas_dense_step(values, self.rate, self.offsets, self.block,
                                  self.interpret, nsteps=self.nsteps)
+
+
+# -- general fused FIELD-FLOW kernel (multi-channel, any pointwise flow) -----
+
+def _field_call(chans, names, flows, *, block, offsets, interpret, nsteps):
+    """Fused multi-channel flow step for ARBITRARY pointwise field flows
+    (``Coupled``, user flows — anything whose outflow reads only the
+    cell's own channels), dense mode.
+
+    One HBM round-trip per channel per ``nsteps`` flow steps: every
+    channel's halo window is DMA'd to VMEM (same piecewise clamped-window
+    machinery as ``_stencil_call``), then each step computes every flow's
+    outflow ELEMENTWISE ON THE WINDOWS via the flow's own ``outflow()``
+    (all outflows read the pre-step values — the summed-outflow
+    semantics of ``Model.make_step``), applies the exact masked
+    per-cell-count transport, and shrinks the region one ring. Channels
+    without flows (pure modulators) ride along unchanged.
+
+    Unlike the Diffusion kernel there is no closed-form interior fast
+    path — the outflow varies per cell — so the exact form runs on every
+    tile; the cost is a divide and a mask per cell-step, which the
+    multi-step fusion amortizes. BASELINE config 4 (multi-attribute
+    coupled flows) is the target workload.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    v0 = chans[0]
+    h, w = v0.shape
+    dtype = v0.dtype
+    bh, bw = block
+    SUB = _sublane(dtype)
+    gi, gj = h // bh, w // bw
+    hr = min(SUB, bh)
+    hc = min(LANE, bw)
+    if nsteps < 1:
+        raise ValueError(f"nsteps must be >= 1, got {nsteps}")
+    if nsteps > min(hr, hc):
+        raise ValueError(
+            f"nsteps={nsteps} exceeds the window's ghost depth "
+            f"min(hr={hr}, hc={hc}) for block {(bh, bw)}")
+    wh, ww = bh + 2 * hr, bw + 2 * hc
+    MH, MW = bh + 2 * nsteps, bw + 2 * nsteps
+    C = len(chans)
+    n_pieces = 1 + 2 * (gi > 1) + 2 * (gj > 1) + 4 * (gi > 1 and gj > 1)
+    H, W = h, w
+    row_m = math.gcd(bh, hr)
+    col_m = math.gcd(bw, hc)
+    ntiles = gi * gj
+    _i32 = np.int32
+    # only channels some flow writes get kernel outputs — flow-less
+    # modulator channels stay inputs (windows are still fetched for the
+    # outflow reads) but skip the per-step mask math's HBM write-back
+    flow_attrs = {f.attr for f in flows}
+    out_names = tuple(n for n in names if n in flow_attrs)
+    n_out = len(out_names)
+
+    def kernel(*refs):
+        chan_refs = refs[:C]
+        out_refs = refs[C:C + n_out]
+        vwin, sems = refs[C + n_out:]
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        n = i * _i32(gj) + j
+        slot = lax.rem(n, _i32(2))
+
+        def ds(start, size, m):
+            if isinstance(start, (int, np.integer)):
+                return pl.ds(_i32(start), size)
+            if m > 1:
+                start = pl.multiple_of(start, m)
+            return pl.ds(start, size)
+
+        def pieces_for(ti, tj):
+            tr = ti * bh
+            tc = tj * bw
+            ps = [(hr, hc, bh, bw, None, tr, tc)]                 # centre
+            if gi > 1:
+                ps += [(0, hc, hr, bw, ti > 0, tr - hr, tc),       # N
+                       (hr + bh, hc, hr, bw, ti < gi - 1, tr + bh, tc)]
+            if gj > 1:
+                ps += [(hr, 0, bh, hc, tj > 0, tr, tc - hc),       # W
+                       (hr, hc + bw, bh, hc, tj < gj - 1, tr, tc + bw)]
+            if gi > 1 and gj > 1:
+                ps += [
+                    (0, 0, hr, hc, (ti > 0) & (tj > 0), tr - hr, tc - hc),
+                    (0, hc + bw, hr, hc, (ti > 0) & (tj < gj - 1),
+                     tr - hr, tc + bw),
+                    (hr + bh, 0, hr, hc, (ti < gi - 1) & (tj > 0),
+                     tr + bh, tc - hc),
+                    (hr + bh, hc + bw, hr, hc,
+                     (ti < gi - 1) & (tj < gj - 1), tr + bh, tc + bw),
+                ]
+            return ps
+
+        def copies_for(ti, tj, sl):
+            out = []
+            for p, (dr, dc, nr, nc, cond, sr, sc) in enumerate(
+                    pieces_for(ti, tj)):
+                for c in range(C):
+                    cp = pltpu.make_async_copy(
+                        chan_refs[c].at[ds(sr, nr, row_m), ds(sc, nc, col_m)],
+                        vwin.at[c, sl, pl.ds(dr, nr), pl.ds(dc, nc)],
+                        sems.at[sl, _i32(c), _i32(p)])
+                    out.append((cond, cp))
+            return out
+
+        def start_fetch(ti, tj, sl, guard=None):
+            clipped = ((ti == 0) | (ti == gi - 1)
+                       | (tj == 0) | (tj == gj - 1))
+
+            @pl.when(clipped if guard is None else (guard & clipped))
+            def _():
+                for c in range(C):
+                    vwin[c, sl] = jnp.zeros((wh, ww), vwin.dtype)
+
+            for cond, cp in copies_for(ti, tj, sl):
+                g = guard if cond is None else (
+                    cond if guard is None else (guard & cond))
+                if g is None:
+                    cp.start()
+                else:
+                    pl.when(g)(cp.start)
+
+        def wait_fetch(ti, tj, sl):
+            for cond, cp in copies_for(ti, tj, sl):
+                if cond is None:
+                    cp.wait()
+                else:
+                    pl.when(cond)(cp.wait)
+
+        @pl.when(n == 0)
+        def _():
+            start_fetch(i, j, slot)
+
+        nn = n + _i32(1)
+        ii = lax.div(nn, _i32(gj))
+        jj = lax.rem(nn, _i32(gj))
+        start_fetch(ii, jj, lax.rem(nn, _i32(2)), guard=nn < _i32(ntiles))
+        wait_fetch(i, j, slot)
+
+        g_r0 = i * bh
+        g_c0 = j * bw
+        row_g = (g_r0 - _i32(nsteps)) + lax.broadcasted_iota(
+            jnp.int32, (MH, MW), 0)
+        col_g = (g_c0 - _i32(nsteps)) + lax.broadcasted_iota(
+            jnp.int32, (MH, MW), 1)
+        mask = ((row_g >= 0) & (row_g < H)
+                & (col_g >= 0) & (col_g < W)).astype(jnp.float32)
+        cnt = jnp.zeros((MH, MW), jnp.float32)
+        for dx, dy in offsets:
+            ok = ((row_g + _i32(dx) >= 0) & (row_g + _i32(dx) < H)
+                  & (col_g + _i32(dy) >= 0) & (col_g + _i32(dy) < W))
+            cnt = cnt + ok.astype(jnp.float32)
+        cnt = jnp.maximum(cnt, 1.0)
+
+        cur = {
+            names[c]: vwin[c, slot, pl.ds(hr - nsteps, MH),
+                           pl.ds(hc - nsteps, MW)].astype(jnp.float32)
+            * mask
+            for c in range(C)
+        }
+        for s in range(nsteps):
+            hs, ws = MH - 2 * s, MW - 2 * s
+            m_s = mask[s:MH - s, s:MW - s]
+            # ALL outflows read the PRE-step window values (summed-
+            # outflow semantics, Model.make_step), then are masked to the
+            # grid: a flow with outflow(0) != 0 (affine user flows) must
+            # not manufacture mass on off-grid ghost cells that the
+            # inflow gather would leak into real boundary cells
+            outflows = {}
+            for f in flows:
+                o = f.outflow(cur) * m_s
+                outflows[f.attr] = (outflows[f.attr] + o
+                                    if f.attr in outflows else o)
+            cnt_s = cnt[s:MH - s, s:MW - s]
+            m_next = mask[s + 1:MH - s - 1, s + 1:MW - s - 1]
+            new = {}
+            for name, cw in cur.items():
+                of = outflows.get(name)
+                if of is None:
+                    new[name] = cw[1:hs - 1, 1:ws - 1]  # modulator only
+                    continue
+                share = of / cnt_s
+                inflow = None
+                for dx, dy in offsets:
+                    t = share[1 + dx:hs - 1 + dx, 1 + dy:ws - 1 + dy]
+                    inflow = t if inflow is None else inflow + t
+                new[name] = (cw[1:hs - 1, 1:ws - 1]
+                             - of[1:hs - 1, 1:ws - 1] + inflow) * m_next
+            cur = new
+        for o, name in enumerate(out_names):
+            out_refs[o][...] = cur[name].astype(dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(gi, gj),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.HBM)] * C,
+        out_specs=[pl.BlockSpec((bh, bw), lambda i, j: (i, j))] * n_out,
+        out_shape=[jax.ShapeDtypeStruct((h, w), dtype)] * n_out,
+        scratch_shapes=[
+            pltpu.VMEM((C, 2, wh, ww), dtype),
+            pltpu.SemaphoreType.DMA((2, C, n_pieces)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=96 * 1024 * 1024),
+        interpret=interpret,
+    )(*chans)
+
+
+class PallasFieldStep:
+    """Reusable fused stepper for ANY set of pointwise field flows over a
+    multi-channel grid (``Coupled`` etc.) — the general form of
+    ``PallasDiffusionStep``. Called with the full values dict; returns
+    the updated dict (modulator-only channels unchanged)."""
+
+    def __init__(self, shape: tuple[int, int], flows, dtype=jnp.float32,
+                 offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS,
+                 block: Optional[tuple[int, int]] = None,
+                 interpret: Optional[bool] = None, nsteps: int = 1):
+        for f in flows:
+            if getattr(f, "footprint", "unknown") != "pointwise":
+                raise ValueError(
+                    f"PallasFieldStep requires pointwise flows; "
+                    f"{type(f).__name__} declares "
+                    f"footprint={getattr(f, 'footprint', 'unknown')!r}")
+        self.shape = tuple(shape)
+        self.flows = tuple(flows)
+        self.offsets = check_offsets(offsets)
+        self.block = block
+        self.interpret = interpret
+        self.nsteps = int(nsteps)
+        self._jitted = {}
+
+    def __call__(self, values: dict) -> dict:
+        names = tuple(sorted(values))
+        fn = self._jitted.get(names)
+        if fn is None:
+            h, w = self.shape
+            sample = values[names[0]]
+            interpret = (resolve_interpret(sample)
+                         if self.interpret is None else self.interpret)
+            if self.block is None:
+                sub = _sublane(sample.dtype)
+                block = (_pick_block(h, 512, sub),
+                         _pick_block(w, 512, LANE))
+            else:
+                block = _validate_block(h, w, self.block)
+            flows = self.flows
+            offsets = self.offsets
+            nsteps = self.nsteps
+
+            flow_attrs = {f.attr for f in flows}
+            out_names = tuple(n for n in names if n in flow_attrs)
+
+            @jax.jit
+            def fn(vals):
+                chans = tuple(vals[n] for n in names)
+                outs = _field_call(chans, names, flows, block=block,
+                                   offsets=offsets,
+                                   interpret=bool(interpret),
+                                   nsteps=nsteps)
+                # modulator-only channels pass through untouched
+                return {**vals, **dict(zip(out_names, outs))}
+
+            self._jitted[names] = fn
+        return fn(dict(values))

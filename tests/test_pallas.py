@@ -120,16 +120,34 @@ def test_model_impl_pallas_matches_xla():
     assert rep_p.conservation_error() < 1e-3
 
 
-def test_model_impl_pallas_rejects_coupled():
+def test_model_impl_pallas_accepts_coupled_rejects_nonpointwise():
+    """Round 3: Coupled (any pointwise field flow) now runs the fused
+    field kernel under impl='pallas'; only non-pointwise flows are
+    rejected."""
     space = CellularSpace.create(16, 16, {"a": 1.0, "b": 2.0},
                                  dtype="float32")
     model = Model([Coupled(flow_rate=0.1, attr="a", modulator="b")], 1.0, 1.0)
-    with pytest.raises(ValueError, match="pallas"):
-        model.make_step(space, impl="pallas")
-    # auto silently falls back to the XLA path
-    step = model.make_step(space, impl="auto")
+    step = model.make_step(space, impl="pallas")
+    assert step.impl == "pallas"
     out = step(dict(space.values))
     assert out["a"].shape == (16, 16)
+
+    from mpi_model_tpu.ops.flow import Flow as FlowBase
+
+    class RingFlow(FlowBase):
+        footprint = "ring1"
+        attr = "a"
+
+        def outflow_padded(self, padded, origin=(0, 0)):
+            return padded["a"][1:-1, 1:-1] * 0.1
+
+    model2 = Model([RingFlow()], 1.0, 1.0)
+    with pytest.raises(ValueError, match="POINTWISE"):
+        model2.make_step(space, impl="pallas")
+    # auto silently falls back to the XLA path
+    step2 = model2.make_step(space, impl="auto")
+    out2 = step2(dict(space.values))
+    assert out2["a"].shape == (16, 16)
 
 
 def test_model_impl_auto_uses_pallas_when_eligible():
@@ -314,3 +332,141 @@ def test_auto_oversized_substeps_falls_back_to_xla():
         warnings.simplefilter("ignore")
         s = model.make_step(space, impl="auto", substeps=200)
     assert s.impl == "xla" and s.substeps == 200
+
+
+# -- general fused field-flow kernel (PallasFieldStep) -----------------------
+
+def _coupled_setup(h=40, w=256, dtype=jnp.float32):
+    rng = np.random.default_rng(5)
+    vals = {"a": jnp.asarray(rng.uniform(0.5, 2.0, (h, w)), dtype),
+            "b": jnp.asarray(rng.uniform(0.5, 2.0, (h, w)), dtype)}
+    flows = [Diffusion(0.1, attr="a"),
+             Coupled(flow_rate=0.05, attr="a", modulator="b"),
+             Diffusion(0.2, attr="b")]
+    space = CellularSpace.create(h, w, {"a": 1.0, "b": 1.0},
+                                 dtype=dtype).with_values(vals)
+    return space, Model(flows, 4.0, 1.0), vals
+
+
+@pytest.mark.parametrize("ns", [1, 4])
+def test_field_kernel_matches_xla(ns):
+    """Coupled multi-attribute flows through the fused field kernel ==
+    the XLA path (all outflows read pre-step values)."""
+    space, model, vals = _coupled_setup()
+    sp = model.make_step(space, impl="pallas", substeps=ns)
+    assert sp.impl == "pallas"
+    sx = model.make_step(space, impl="xla")
+    got = sp(dict(vals))
+    want = dict(vals)
+    for _ in range(ns):
+        want = sx(want)
+    for k in ("a", "b"):
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   rtol=1e-4, atol=1e-4 * ns)
+
+
+def test_field_kernel_interior_tiles():
+    """>=3 tiles per dim so genuine interior tiles run (not just the
+    grid-ring masked boundary work)."""
+    space, model, vals = _coupled_setup(h=40, w=640)
+    from mpi_model_tpu.ops.pallas_stencil import PallasFieldStep
+
+    stepper = PallasFieldStep((40, 640), model.flows, block=(8, 128),
+                              interpret=True, nsteps=4)
+    got = stepper(dict(vals))
+    sx = model.make_step(space, impl="xla")
+    want = dict(vals)
+    for _ in range(4):
+        want = sx(want)
+    for k in ("a", "b"):
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_field_kernel_auto_selected_and_conserves():
+    space, model, _ = _coupled_setup()
+    s = model.make_step(space, impl="auto")
+    assert s.impl == "pallas"
+    out, rep = model.execute(space, steps=4)
+    assert rep.conservation_error() < model.conservation_threshold(space)
+
+
+def test_field_kernel_modulator_channel_untouched():
+    from mpi_model_tpu.ops.pallas_stencil import PallasFieldStep
+
+    space, _, vals = _coupled_setup()
+    stepper = PallasFieldStep(
+        (40, 256), [Coupled(flow_rate=0.05, attr="a", modulator="b")],
+        interpret=True, nsteps=2)
+    got = stepper(dict(vals))
+    np.testing.assert_array_equal(np.asarray(got["b"]),
+                                  np.asarray(vals["b"]))
+
+
+def test_field_kernel_composes_with_point_flow():
+    from mpi_model_tpu import PointFlow
+
+    space, model, vals = _coupled_setup()
+    m2 = Model(model.flows + [PointFlow(source=(5, 5), flow_rate=0.3,
+                                        attr="a")], 1.0, 1.0)
+    s2 = m2.make_step(space, impl="auto")
+    assert s2.impl == "pallas"
+    got = s2(dict(vals))
+    want = m2.make_step(space, impl="xla")(dict(vals))
+    np.testing.assert_allclose(np.asarray(got["a"]), np.asarray(want["a"]),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_field_kernel_rejects_non_pointwise():
+    from mpi_model_tpu.ops.flow import Flow as FlowBase
+    from mpi_model_tpu.ops.pallas_stencil import PallasFieldStep
+
+    class RingFlow(FlowBase):
+        footprint = "ring1"
+        attr = "value"
+
+        def outflow_padded(self, padded, origin=(0, 0)):
+            return padded["value"][1:-1, 1:-1] * 0.1
+
+    with pytest.raises(ValueError, match="pointwise"):
+        PallasFieldStep((8, 8), [RingFlow()])
+
+
+def test_field_kernel_affine_flow_no_ghost_leak():
+    """A pointwise flow with outflow(0) != 0 (affine) must not
+    manufacture mass on off-grid ghost cells: the kernel masks outflows
+    to the grid before sharing."""
+    import dataclasses
+
+    from mpi_model_tpu.ops.flow import Flow as FlowBase
+    from mpi_model_tpu.ops.pallas_stencil import PallasFieldStep
+
+    @dataclasses.dataclass
+    class Affine(FlowBase):
+        flow_rate: float = 0.05
+        capacity: float = 3.0
+        attr: str = "a"
+        footprint = "pointwise"
+
+        def outflow(self, values, origin=(0, 0)):
+            return self.flow_rate * (self.capacity - values[self.attr])
+
+        def fingerprint(self):
+            return ("Affine", self.flow_rate, self.capacity, self.attr)
+
+    rng = np.random.default_rng(8)
+    vals = {"a": jnp.asarray(rng.uniform(0.5, 2.0, (24, 256)), jnp.float32)}
+    space = CellularSpace.create(24, 256, 1.0,
+                                 dtype=jnp.float32).with_values(vals)
+    model = Model([Affine()], 3.0, 1.0)
+    sx = model.make_step(space, impl="xla")
+    for ns in (1, 4):
+        stepper = PallasFieldStep((24, 256), model.flows, block=(8, 128),
+                                  interpret=True, nsteps=ns)
+        got = stepper(dict(vals))
+        want = dict(vals)
+        for _ in range(ns):
+            want = sx(want)
+        np.testing.assert_allclose(np.asarray(got["a"]),
+                                   np.asarray(want["a"]),
+                                   rtol=1e-5, atol=1e-5 * ns)
