@@ -876,6 +876,10 @@ def _field_call(chans, names, flows, *, block, offsets, interpret, nsteps):
         for s in range(nsteps):
             hs, ws = MH - 2 * s, MW - 2 * s
             m_s = mask[s:MH - s, s:MW - s]
+            # the region's [0,0] sits (nsteps - s) cells before the
+            # tile's global origin — origin-reading pointwise flows
+            # (spatially varying rates) need the true coordinate
+            org_s = (g_r0 - _i32(nsteps - s), g_c0 - _i32(nsteps - s))
             # ALL outflows read the PRE-step window values (summed-
             # outflow semantics, Model.make_step), then are masked to the
             # grid: a flow with outflow(0) != 0 (affine user flows) must
@@ -883,7 +887,7 @@ def _field_call(chans, names, flows, *, block, offsets, interpret, nsteps):
             # inflow gather would leak into real boundary cells
             outflows = {}
             for f in flows:
-                o = f.outflow(cur) * m_s
+                o = f.outflow(cur, org_s) * m_s
                 outflows[f.attr] = (outflows[f.attr] + o
                                     if f.attr in outflows else o)
             cnt_s = cnt[s:MH - s, s:MW - s]

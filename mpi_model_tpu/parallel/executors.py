@@ -267,25 +267,40 @@ class ShardMapExecutor:
     def _build_deep_runner(self, model, space: CellularSpace,
                            num_steps: int):
         """Deep-halo execution: one depth-d ghost exchange per d local
-        steps. The padded shard [h+2d, w+2d] is iterated d times with the
-        exact per-cell-count form — share = rate*v/count, in-grid mask —
-        on a region shrinking one ring per step, mirroring
-        ``ops.stencil.transport``'s expression term-for-term so in-grid
-        cells are BITWISE what the serial path computes. Collective
-        rounds (the 0.64-0.81 halo share measured in BASELINE configs
-        2-3) drop d-fold."""
+        steps, for ANY pointwise field flows (Diffusion, Coupled, user
+        flows). All channels are padded; each step evaluates every flow's
+        own ``outflow()`` on the pre-step padded values (summed-outflow
+        semantics), masks outflows to the partition (affine flows must
+        not manufacture mass on ghost cells), and applies the exact
+        per-cell-count transport on a region shrinking one ring per step
+        — mirroring ``ops.stencil.transport``'s expression term-for-term.
+        All-Diffusion models reproduce the serial path BITWISE (the
+        uniform-rate expression compiles to the same contraction);
+        general flows match to ~1 ULP (XLA FMA grouping of the summed
+        outflow differs between compilations). Collective rounds (the
+        0.64-0.81 halo share measured in BASELINE configs 2-3) drop
+        d-fold."""
         from jax import lax
 
         depth = self.halo_depth
-        rates = model.pallas_rates()
+        field_flows = [f for f in model.flows
+                       if not isinstance(f, PointFlow)]
         has_point = any(isinstance(f, PointFlow) for f in model.flows)
-        if rates is None or has_point:
+        all_pointwise = bool(field_flows) and all(
+            getattr(f, "footprint", "unknown") == "pointwise"
+            for f in field_flows)
+        if not all_pointwise or has_point:
             raise ValueError(
-                "halo_depth > 1 requires all flows to be plain Diffusion "
-                "(a point flow must fire between steps, which deep-halo "
-                f"chunks cannot interleave); got "
+                "halo_depth > 1 requires POINTWISE field flows and no "
+                "point flows (a point flow must fire between steps, "
+                "which deep-halo chunks cannot interleave); got "
                 f"flows={[type(f).__name__ for f in model.flows]}. "
                 "Use halo_depth=1 for general flows.")
+        # all-Diffusion models take the uniform-rate expression whose
+        # compiled graph matches the serial path BITWISE; general
+        # pointwise flows take the summed-outflow form, which XLA's FMA
+        # contraction may round differently by ~1 ULP
+        uniform_rates = model.pallas_rates()
 
         mesh = self.mesh
         names = mesh.axis_names
@@ -338,11 +353,11 @@ class ShardMapExecutor:
                 jnp.int32, (PH, PW), 0)
             colg = (col0 - np.int32(D)) + lax.broadcasted_iota(
                 jnp.int32, (PH, PW), 1)
-            maskD = ((rowg >= np.int32(x_init))
-                     & (rowg < np.int32(x_init) + np.int32(space.dim_x))
-                     & (colg >= np.int32(y_init))
-                     & (colg < np.int32(y_init) + np.int32(space.dim_y))
-                     ).astype(dtype)
+            maskD_b = ((rowg >= np.int32(x_init))
+                       & (rowg < np.int32(x_init) + np.int32(space.dim_x))
+                       & (colg >= np.int32(y_init))
+                       & (colg < np.int32(y_init) + np.int32(space.dim_y)))
+            maskD = maskD_b.astype(dtype)
             from ..ops.stencil import neighbor_counts_traced
             cntD = jnp.maximum(
                 neighbor_counts_traced(
@@ -350,30 +365,76 @@ class ShardMapExecutor:
                     (row0 - np.int32(D), col0 - np.int32(D)), gshape, dtype),
                 jnp.asarray(1, dtype))
 
-            def chunk(c, d):
-                """d steps after one depth-d exchange (d static)."""
+            def transport_step(cur, of, cnt_s, m, s, hs, ws):
+                share = of / cnt_s
+                inflow = None
+                for dx, dy in offsets:
+                    t = share[1 + dx:hs - 1 + dx, 1 + dy:ws - 1 + dy]
+                    inflow = t if inflow is None else inflow + t
+                return ((cur[1:hs - 1, 1:ws - 1]
+                         - of[1:hs - 1, 1:ws - 1] + inflow)
+                        * m[s + 1:s + hs - 1, s + 1:s + ws - 1])
+
+            def chunk_uniform(c, d):
+                """All-Diffusion: per-attr uniform-rate expression —
+                compiles to the serial path's exact contraction (BITWISE
+                parity); flow-less channels are never padded/exchanged."""
                 off = D - d
                 m = maskD[off:PH - off, off:PW - off]
                 cnt = cntD[off:PH - off, off:PW - off]
                 new = dict(c)
-                for attr, rate in rates.items():
+                for attr, rate in uniform_rates.items():
                     if rate == 0.0:
                         continue
                     cur = pad_deep(c[attr], d) * m
                     for s in range(d):
                         hs, ws = cur.shape
-                        outflow = rate * cur
-                        share = outflow / cnt[s:s + hs, s:s + ws]
-                        inflow = None
-                        for dx, dy in offsets:
-                            t = share[1 + dx:hs - 1 + dx,
-                                      1 + dy:ws - 1 + dy]
-                            inflow = t if inflow is None else inflow + t
-                        nxt = (cur[1:hs - 1, 1:ws - 1]
-                               - outflow[1:hs - 1, 1:ws - 1] + inflow)
-                        cur = nxt * m[s + 1:s + hs - 1, s + 1:s + ws - 1]
+                        cur = transport_step(cur, rate * cur,
+                                             cnt[s:s + hs, s:s + ws],
+                                             m, s, hs, ws)
                     new[attr] = cur
                 return new
+
+            def chunk_general(c, d):
+                """General pointwise flows: every channel rides the
+                padded region (modulators are read by other flows'
+                outflows at the shrinking shapes); ~1 ULP vs serial
+                (XLA FMA grouping of the summed outflow)."""
+                off = D - d
+                m = maskD[off:PH - off, off:PW - off]
+                mb = maskD_b[off:PH - off, off:PW - off]
+                cnt = cntD[off:PH - off, off:PW - off]
+                cur = {k: pad_deep(v, d) * m for k, v in c.items()}
+                for s in range(d):
+                    hs, ws = next(iter(cur.values())).shape
+                    cnt_s = cnt[s:s + hs, s:s + ws]
+                    mb_s = mb[s:s + hs, s:s + ws]
+                    # the region's [0,0] sits d-s cells before the shard
+                    # origin — origin-reading pointwise flows need it
+                    org_s = (row0 - np.int32(d - s), col0 - np.int32(d - s))
+                    # all outflows read the PRE-step values; the
+                    # where-SELECT (bitwise passthrough in-partition)
+                    # masks ghost cells so affine outflow(0) != 0 flows
+                    # don't manufacture mass there
+                    outflows = {}
+                    for f in field_flows:
+                        o = jnp.where(mb_s, f.outflow(cur, org_s),
+                                      jnp.asarray(0, dtype))
+                        outflows[f.attr] = (outflows[f.attr] + o
+                                            if f.attr in outflows else o)
+                    new = {}
+                    for k2, cw in cur.items():
+                        of = outflows.get(k2)
+                        if of is None:
+                            new[k2] = cw[1:hs - 1, 1:ws - 1]
+                            continue
+                        new[k2] = transport_step(cw, of, cnt_s, m, s,
+                                                 hs, ws)
+                    cur = new
+                return cur
+
+            chunk = (chunk_uniform if uniform_rates is not None
+                     else chunk_general)
 
             q, r = divmod(num_steps, D)
             out = values

@@ -470,3 +470,40 @@ def test_field_kernel_affine_flow_no_ghost_leak():
         np.testing.assert_allclose(np.asarray(got["a"]),
                                    np.asarray(want["a"]),
                                    rtol=1e-5, atol=1e-5 * ns)
+
+
+def test_field_kernel_origin_reading_flow():
+    """The field kernel hands origin-reading pointwise flows the true
+    global coordinate of the (shrinking) window region."""
+    from mpi_model_tpu.ops.flow import Flow as FlowBase
+    from mpi_model_tpu.ops.pallas_stencil import PallasFieldStep
+
+    class RowRate(FlowBase):
+        footprint = "pointwise"
+        attr = "a"
+
+        def outflow(self, values, origin=(0, 0)):
+            v = values[self.attr]
+            rows = origin[0] + jax.lax.broadcasted_iota(
+                jnp.int32, v.shape, 0)
+            return 0.002 * rows.astype(v.dtype) * v
+
+        def fingerprint(self):
+            return ("RowRate", 0.002)
+
+    rng = np.random.default_rng(9)
+    vals = {"a": jnp.asarray(rng.uniform(0.5, 2.0, (40, 256)), jnp.float32)}
+    space = CellularSpace.create(40, 256, 1.0,
+                                 dtype=jnp.float32).with_values(vals)
+    model = Model([RowRate()], 3.0, 1.0)
+    sx = model.make_step(space, impl="xla")
+    for ns in (1, 4):
+        stepper = PallasFieldStep((40, 256), model.flows, block=(8, 128),
+                                  interpret=True, nsteps=ns)
+        got = stepper(dict(vals))
+        want = dict(vals)
+        for _ in range(ns):
+            want = sx(want)
+        np.testing.assert_allclose(np.asarray(got["a"]),
+                                   np.asarray(want["a"]),
+                                   rtol=1e-5, atol=1e-5 * ns)
