@@ -15,10 +15,14 @@ names the natural seed for a real design. Here that becomes:
 - ``run_checkpointed`` — the chunked execute loop proving
   resume-equivalence (restart produces bit-identical state).
 
-Checkpoints are host-side by design: state is fetched with
-``jax.device_get`` (the process-0 gather of a sharded array) and
-restored with plain ``jnp.asarray`` — re-sharding is the executor's job
-on the next run, exactly like the reference re-scatters on restart.
+Checkpoints are host-side by design: state is fetched with the
+multihost-safe global gather (``parallel.multihost.gather_global`` — a
+plain ``device_get`` single-process, a cross-host allgather under
+``jax.distributed``), ONLY process 0 writes (the reference's master
+merge), every process barriers on the save, and restore is a plain
+``jnp.asarray`` — re-sharding is the executor's job on the next run,
+exactly like the reference re-scatters on restart. Multi-host restore
+assumes the checkpoint directory is on a filesystem every host sees.
 """
 
 from __future__ import annotations
@@ -49,7 +53,15 @@ class Checkpoint:
 
 def save_checkpoint(path: str, space: CellularSpace, step: int = 0,
                     extra: Optional[dict] = None) -> str:
-    """Serialize ``space`` (+ step counter) to ``path`` atomically."""
+    """Serialize ``space`` (+ step counter) to ``path`` atomically.
+
+    Multihost-safe: channels are gathered with the cross-host-aware
+    global gather (every process participates), only process 0 writes
+    the file, and all processes barrier before returning — so a
+    supervised run under ``jax.distributed`` checkpoints exactly once
+    per cluster, the way the reference's master merges rank files."""
+    from ..parallel.multihost import gather_global, is_master, sync
+
     meta: dict[str, Any] = {
         "format": FORMAT_VERSION,
         "step": int(step),
@@ -64,23 +76,30 @@ def save_checkpoint(path: str, space: CellularSpace, step: int = 0,
     }
     payload: dict[str, np.ndarray] = {}
     for name, arr in space.values.items():
-        a = np.ascontiguousarray(jax.device_get(arr))
+        a = np.ascontiguousarray(gather_global(arr))
         meta["channels"][name] = {"dtype": str(a.dtype), "shape": a.shape}
         payload[f"ch:{name}"] = a.reshape(-1).view(np.uint8)
     payload["meta"] = np.frombuffer(
         json.dumps(meta).encode("utf-8"), dtype=np.uint8)
 
-    d = os.path.dirname(os.path.abspath(path)) or "."
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    # every process MUST reach the barrier even when the master's write
+    # fails — otherwise a disk error on process 0 strands the workers in
+    # sync() until the cluster heartbeat kills them
     try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **payload)
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+        if is_master():
+            d = os.path.dirname(os.path.abspath(path)) or "."
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    np.savez(f, **payload)
+                os.replace(tmp, path)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+    finally:
+        sync("checkpoint-save")
     return path
 
 
@@ -133,10 +152,17 @@ class CheckpointManager:
 
     def save(self, space: CellularSpace, step: int,
              extra: Optional[dict] = None) -> str:
+        from ..parallel.multihost import is_master, sync
+
         path = save_checkpoint(self.path_for(step), space, step, extra)
-        if self.keep > 0:
-            for old in self.steps()[:-self.keep]:
-                os.unlink(self.path_for(old))
+        try:
+            if self.keep > 0 and is_master():  # one pruner per cluster
+                for old in self.steps()[:-self.keep]:
+                    os.unlink(self.path_for(old))
+        finally:
+            # workers must reach the barrier even if the master's prune
+            # raised (see save_checkpoint)
+            sync("checkpoint-prune")
         return path
 
     def latest(self) -> Optional[Checkpoint]:

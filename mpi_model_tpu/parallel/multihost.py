@@ -138,12 +138,26 @@ assert report.comm_size == 8, report
 full = gather_to_host(out.values["value"])
 assert full.shape == (h, w)
 assert np.isfinite(full).all()
+
+# multihost checkpoint: every process participates in the gather, only
+# process 0 writes, all barrier — then both processes restore and see
+# identical bytes (shared filesystem on one host)
+import os as _os
+from mpi_model_tpu.io import load_checkpoint, save_checkpoint
+ckpt_path = _os.path.join({ckpt_dir!r}, "mh_ckpt.npz")
+save_checkpoint(ckpt_path, out, step=3)
+assert _os.path.exists(ckpt_path), "checkpoint missing after save barrier"
+ck = load_checkpoint(ckpt_path)
+assert ck.step == 3
+np.testing.assert_array_equal(np.asarray(ck.space.values["value"]), full)
+
 multihost.sync("after-run")
 if multihost.is_master():
     # master-side conservation report (Model.hpp:88-95)
     print(f"MASTER ok: procs={{jax.process_count()}} "
           f"total={{float(full.sum())}} "
-          f"conservation_err={{report.conservation_error():.3e}}", flush=True)
+          f"conservation_err={{report.conservation_error():.3e}} "
+          f"ckpt=saved", flush=True)
 else:
     print(f"worker {{multihost.process_index()}} done", flush=True)
 """
@@ -153,34 +167,43 @@ def dryrun_two_process(port: Optional[int] = None, timeout: int = 300) -> str:
     """Launch a real 2-process jax.distributed cluster on this host (4
     virtual CPU devices each → one 2x4 global mesh), run a sharded step
     spanning both processes, and return the master's report line."""
+    import tempfile
+
     if port is None:
         port = 29500 + os.getpid() % 400  # avoid collisions between runs
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    procs = []
-    for pid in (0, 1):
-        env = dict(os.environ)
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-        env.pop("JAX_PLATFORMS", None)
-        code = _WORKER.format(root=root, port=port, pid=pid)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", code], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-    outs = []
+    ckpt_dir = tempfile.mkdtemp(prefix="mmtpu_mh_")
     try:
-        for p in procs:
-            out, err = p.communicate(timeout=timeout)
-            outs.append((p.returncode, out, err))
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        raise
-    for rc, out, err in outs:
-        if rc != 0:
-            raise RuntimeError(
-                f"multihost dryrun worker failed (rc={rc}):\n"
-                f"{out[-2000:]}\n{err[-2000:]}")
-    master_out = outs[0][1]
-    if "MASTER ok" not in master_out:
-        raise RuntimeError(f"no master report in: {master_out!r}")
-    return master_out.strip().splitlines()[-1]
+        procs = []
+        for pid in (0, 1):
+            env = dict(os.environ)
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+            env.pop("JAX_PLATFORMS", None)
+            code = _WORKER.format(root=root, port=port, pid=pid,
+                                  ckpt_dir=ckpt_dir)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", code], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+        outs = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=timeout)
+                outs.append((p.returncode, out, err))
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise
+        for rc, out, err in outs:
+            if rc != 0:
+                raise RuntimeError(
+                    f"multihost dryrun worker failed (rc={rc}):\n"
+                    f"{out[-2000:]}\n{err[-2000:]}")
+        master_out = outs[0][1]
+        if "MASTER ok" not in master_out:
+            raise RuntimeError(f"no master report in: {master_out!r}")
+        return master_out.strip().splitlines()[-1]
+    finally:
+        import shutil
+
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
