@@ -27,10 +27,15 @@ class ModelRectangular(Model):
 
     def __init__(self, flow, time: float = 1.0, time_step: float = 1.0, *,
                  lines: Optional[int] = None, columns: Optional[int] = None,
-                 offsets=None):
+                 offsets=None, step_impl: str = "xla", halo_depth: int = 1):
         super().__init__(flow, time, time_step, offsets=offsets)
         self.lines = lines
         self.columns = columns
+        #: passed through to the default ShardMapExecutor: the per-shard
+        #: kernel ("xla" | "pallas" | "auto") and the deep-halo depth
+        #: (one ghost exchange per ``halo_depth`` steps)
+        self.step_impl = step_impl
+        self.halo_depth = halo_depth
 
     def default_executor(self, devices: Optional[Sequence] = None):
         """ShardMapExecutor on a lines × columns mesh (2-D block halo)."""
@@ -38,7 +43,8 @@ class ModelRectangular(Model):
         from ..parallel.mesh import make_mesh_2d
 
         mesh = make_mesh_2d(self.lines, self.columns, devices=devices)
-        return ShardMapExecutor(mesh)
+        return ShardMapExecutor(mesh, step_impl=self.step_impl,
+                                halo_depth=self.halo_depth)
 
     def execute(self, space, executor=None, **kw):
         if executor is None:

@@ -212,6 +212,9 @@ def supervised_run(
         manager.save(good_space, good_step,
                      extra={"initial_totals": initial})
 
+    from .utils.tracing import get_tracer
+
+    tracer = get_tracer()
     events: list[FailureEvent] = []
     consecutive = 0
     report: Optional[Report] = None
@@ -219,14 +222,16 @@ def supervised_run(
         n = min(every, total - good_step)
         t0 = _time.perf_counter()
         try:
-            # conservation is checked HERE against the run-global baseline;
-            # execute()'s own per-chunk check would re-baseline each chunk
-            out_space, report = model.execute(
-                good_space, executor, steps=n, check_conservation=False)
-            if health_checks:
-                problems = check_health(out_space, initial, threshold)
-                if problems:
-                    raise HealthError(problems)
+            with tracer.span("supervise.chunk", start=good_step, steps=n):
+                # conservation is checked HERE against the run-global
+                # baseline; execute()'s own per-chunk check would
+                # re-baseline each chunk
+                out_space, report = model.execute(
+                    good_space, executor, steps=n, check_conservation=False)
+                if health_checks:
+                    problems = check_health(out_space, initial, threshold)
+                    if problems:
+                        raise HealthError(problems)
         except Exception as exc:  # noqa: BLE001 — supervisor boundary
             consecutive += 1
             ev = FailureEvent(
@@ -238,6 +243,10 @@ def supervised_run(
                 wall_time_s=_time.perf_counter() - t0,
             )
             events.append(ev)
+            tracer.instant("supervise.failure", kind=ev.kind,
+                           step=ev.step, attempt=ev.attempt,
+                           detail=ev.detail,
+                           rolled_back_to=ev.rolled_back_to)
             if on_event is not None:
                 on_event(ev)
             if consecutive > max_failures:

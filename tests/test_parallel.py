@@ -480,3 +480,13 @@ def test_deep_halo_origin_reading_flow(mesh1d):
                                np.asarray(want.values["value"]),
                                rtol=0, atol=1e-13)
     assert rep.conservation_error() < 1e-9
+
+
+def test_model_rectangular_deep_halo_passthrough(eight_devices):
+    space = CellularSpace.create(16, 32, 1.0, dtype=jnp.float64)
+    model = ModelRectangular(Diffusion(0.1), 6.0, 1.0, lines=2, columns=4,
+                             halo_depth=3)
+    out, report = model.execute(space)
+    assert report.comm_size == 8
+    want = serial_result(Model(Diffusion(0.1)), space, 6)
+    np.testing.assert_array_equal(out.to_numpy()["value"], want)

@@ -171,3 +171,35 @@ def test_device_trace_writes_profile(tmp_path):
     for root, _dirs, files in os.walk(logdir):
         found += files
     assert found, "jax.profiler.trace wrote no profile files"
+
+
+def test_supervised_run_emits_chunk_and_failure_spans():
+    from mpi_model_tpu import CellularSpace, Diffusion, Model, supervised_run
+    from mpi_model_tpu.models.model import SerialExecutor
+
+    class OnceFaulty:
+        comm_size = 1
+
+        def __init__(self):
+            self.n = 0
+            self.inner = SerialExecutor()
+
+        def run_model(self, m, s, k):
+            self.n += 1
+            if self.n == 2:
+                raise RuntimeError("injected")
+            return self.inner.run_model(m, s, k)
+
+    tr = Tracer()
+    prev = set_tracer(tr)
+    try:
+        space = CellularSpace.create(8, 8, 1.0, dtype=jnp.float64)
+        res = supervised_run(Model(Diffusion(0.1), 4.0, 1.0), space,
+                             steps=4, every=2, executor=OnceFaulty())
+    finally:
+        set_tracer(prev)
+    assert res.recovered_failures == 1
+    names = [s.name for s in tr.spans]
+    assert names.count("supervise.chunk") == 3  # 2 good + 1 failed attempt
+    fails = [s for s in tr.spans if s.name == "supervise.failure"]
+    assert len(fails) == 1 and fails[0].meta["kind"] == "exception"
