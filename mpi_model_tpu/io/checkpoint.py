@@ -60,7 +60,7 @@ def save_checkpoint(path: str, space: CellularSpace, step: int = 0,
     the file, and all processes barrier before returning — so a
     supervised run under ``jax.distributed`` checkpoints exactly once
     per cluster, the way the reference's master merges rank files."""
-    from ..parallel.multihost import gather_global, is_master, sync
+    from ..parallel.multihost import gather_global, master_only
 
     meta: dict[str, Any] = {
         "format": FORMAT_VERSION,
@@ -82,11 +82,11 @@ def save_checkpoint(path: str, space: CellularSpace, step: int = 0,
     payload["meta"] = np.frombuffer(
         json.dumps(meta).encode("utf-8"), dtype=np.uint8)
 
-    # every process MUST reach the barrier even when the master's write
-    # fails — otherwise a disk error on process 0 strands the workers in
-    # sync() until the cluster heartbeat kills them
-    try:
-        if is_master():
+    # master_only: every process reaches the barrier even when the
+    # master's write fails (a disk error propagates instead of stranding
+    # workers in the barrier)
+    with master_only("checkpoint-save") as master:
+        if master:
             d = os.path.dirname(os.path.abspath(path)) or "."
             os.makedirs(d, exist_ok=True)
             fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
@@ -98,8 +98,6 @@ def save_checkpoint(path: str, space: CellularSpace, step: int = 0,
                 if os.path.exists(tmp):
                     os.unlink(tmp)
                 raise
-    finally:
-        sync("checkpoint-save")
     return path
 
 
@@ -152,17 +150,13 @@ class CheckpointManager:
 
     def save(self, space: CellularSpace, step: int,
              extra: Optional[dict] = None) -> str:
-        from ..parallel.multihost import is_master, sync
+        from ..parallel.multihost import master_only
 
         path = save_checkpoint(self.path_for(step), space, step, extra)
-        try:
-            if self.keep > 0 and is_master():  # one pruner per cluster
+        with master_only("checkpoint-prune") as master:
+            if master and self.keep > 0:  # one pruner per cluster
                 for old in self.steps()[:-self.keep]:
                     os.unlink(self.path_for(old))
-        finally:
-            # workers must reach the barrier even if the master's prune
-            # raised (see save_checkpoint)
-            sync("checkpoint-prune")
         return path
 
     def latest(self) -> Optional[Checkpoint]:

@@ -97,15 +97,26 @@ def write_output(directory: str, space: CellularSpace,
     ``comm_size`` ranks (``Model.hpp:62-76``); the master itself holds no
     cells there, so ranks here are the data-holding workers only.
     """
+    from ..parallel.multihost import broadcast_str, master_only
+
     if partitions is None:
         partitions = row_partitions(space.dim_x, space.dim_y, comm_size)
-    # one global gather (multi-host safe), then host-side partition slices
+    # one global gather (multi-host safe; every process participates),
+    # then ONLY process 0 writes — the reference's master role — with all
+    # processes barriered even if the master's write fails. The filename
+    # is the MASTER's (wall-clock stamps would skew across hosts and
+    # leave workers returning a path that doesn't exist).
     host_space = space.with_values(
         {k: gather_to_host(v) for k, v in space.values.items()})
-    dumps = [
-        write_partition_dump(directory, host_space.slice_partition(p),
-                             p.rank, attr, fmt)
-        for p in partitions
-    ]
-    return merge_dumps(
-        os.path.join(directory, output_filename(timestamp)), dumps)
+    out_path = os.path.join(
+        directory, broadcast_str(output_filename(timestamp)))
+    with master_only("output-write") as master:
+        if master:
+            dumps = [
+                write_partition_dump(directory,
+                                     host_space.slice_partition(p),
+                                     p.rank, attr, fmt)
+                for p in partitions
+            ]
+            merge_dumps(out_path, dumps)
+    return out_path

@@ -105,6 +105,43 @@ def sync(name: str = "barrier") -> None:
     multihost_utils.sync_global_devices(name)
 
 
+import contextlib as _contextlib  # noqa: E402
+
+
+@_contextlib.contextmanager
+def master_only(barrier_name: str):
+    """Master-write-with-barrier idiom, encoded once: the body runs on
+    process 0 only, and EVERY process reaches the barrier even when the
+    master's body raises — a disk error on the master propagates instead
+    of stranding workers in ``sync`` until the cluster heartbeat kills
+    them. Usage::
+
+        with master_only("checkpoint-save") as master:
+            if master:
+                ...write files...
+    """
+    try:
+        yield is_master()
+    finally:
+        sync(barrier_name)
+
+
+def broadcast_str(s: str, max_len: int = 256) -> str:
+    """Process 0's string, delivered to every process (single-process:
+    identity). Used for values that must agree cluster-wide but are
+    derived from per-process state — e.g. a wall-clock-stamped output
+    filename."""
+    import jax
+    if jax.process_count() == 1:
+        return s
+    from jax.experimental import multihost_utils
+    buf = np.zeros(max_len, np.uint8)
+    raw = s.encode("utf-8")[:max_len]
+    buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf)
+    return bytes(np.asarray(out)).rstrip(b"\x00").decode("utf-8")
+
+
 # -- two-local-process CPU dryrun (the hardware-free config-5 rig) -----------
 
 _WORKER = r"""
@@ -150,6 +187,13 @@ assert _os.path.exists(ckpt_path), "checkpoint missing after save barrier"
 ck = load_checkpoint(ckpt_path)
 assert ck.step == 3
 np.testing.assert_array_equal(np.asarray(ck.space.values["value"]), full)
+
+# output pipeline: filename is the MASTER's (broadcast — wall clocks may
+# skew across hosts), process 0 writes, all barrier; every process must
+# see the same existing file
+from mpi_model_tpu.io import write_output
+merged = write_output({ckpt_dir!r}, out, comm_size=2)
+assert _os.path.exists(merged), merged
 
 multihost.sync("after-run")
 if multihost.is_master():
