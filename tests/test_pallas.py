@@ -507,3 +507,39 @@ def test_field_kernel_origin_reading_flow():
         np.testing.assert_allclose(np.asarray(got["a"]),
                                    np.asarray(want["a"]),
                                    rtol=1e-5, atol=1e-5 * ns)
+
+
+# -- randomized property sweep (seeded) --------------------------------------
+
+@pytest.mark.parametrize("seed", range(6))
+def test_property_random_config_matches_oracle(seed):
+    """Seeded random (shape, block, nsteps, offsets, rate) configs: the
+    fused kernel must match the composed oracle everywhere — the
+    catch-all net for geometry/boundary interactions the targeted tests
+    don't enumerate."""
+    rng = np.random.default_rng(1000 + seed)
+    h = int(rng.integers(5, 70))
+    w = int(rng.integers(5, 300))
+    # random divisor block
+    h_divs = [d for d in range(1, h + 1) if h % d == 0]
+    w_divs = [d for d in range(1, w + 1) if w % d == 0]
+    bh = int(rng.choice(h_divs))
+    bw = int(rng.choice(w_divs))
+    offs = MOORE_OFFSETS if rng.random() < 0.5 else VON_NEUMANN_OFFSETS
+    from mpi_model_tpu.ops.pallas_stencil import LANE, _sublane
+    ns_max = min(bh, _sublane(np.float32), bw, LANE)
+    ns = int(rng.integers(1, ns_max + 1))
+    rate = float(rng.uniform(0.02, 0.4))
+
+    v = rng.uniform(0.5, 2.0, (h, w)).astype(np.float32)
+    want = v.astype(np.float64)
+    for _ in range(ns):
+        want = dense_flow_step_np(want, rate, offsets=offs)
+    got = np.asarray(pallas_dense_step(jnp.asarray(v), rate, offsets=offs,
+                                       block=(bh, bw), interpret=True,
+                                       nsteps=ns), np.float64)
+    np.testing.assert_allclose(
+        got, want, rtol=1e-5, atol=1e-5,
+        err_msg=f"shape=({h},{w}) block=({bh},{bw}) ns={ns} "
+                f"rate={rate:.3f} offsets={'moore' if len(offs)==8 else 'vn'}")
+    assert abs(got.sum() - v.astype(np.float64).sum()) < 1e-2
