@@ -13,9 +13,11 @@ publishes no numbers, SURVEY §6).
 
 Before timing, the kernel is VALIDATED ON THE BENCH DEVICE against the
 NumPy oracle (single- and multi-step, tolerance scaled to dtype) — the
-hardware-correctness gate that round-2 VERDICT weak #9 found missing; a
-mismatch aborts the run with an error JSON instead of reporting a fast
-wrong kernel.
+hardware-correctness gate that round-2 VERDICT weak #9 found missing. A
+validation failure, or a bench step resolving to a Pallas kernel the
+gate never checked, aborts with an error JSON; a fall-back to the
+(suite-oracle-tested) XLA path is reported honestly with an
+"xla-fallback" label instead of zeroing the bench.
 
 Timing note: the remote-TPU tunnel adds ~100ms fixed dispatch overhead
 per call, so the per-step cost is measured MARGINALLY — two scan lengths
@@ -42,8 +44,8 @@ def validate_on_device(substeps: int, dtype_name: str = "bfloat16",
     would be entirely 'near-ring' and only check the exact masked
     branch). Runs in f32 (tight tolerance) and in the bench dtype
     (storage-rounding tolerance). Returns {dtype_name: impl} of the
-    validated steps so the caller can assert the step it times resolved
-    to the same kernel; raises on mismatch."""
+    validated steps so the caller can check which kernel the gate
+    actually proved; raises on an oracle mismatch."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -101,13 +103,21 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
     step = model.make_step(space, impl="auto", substeps=substeps)
     impl_used = step.impl
     if impl_used != validated[dtype_name]:
-        # "auto" resolves per geometry: the validated kernel and the
-        # timed kernel must be the same implementation, or the gate
-        # proved nothing about what we are about to time
-        raise AssertionError(
-            f"impl mismatch: validated {validated[dtype_name]!r} at "
-            f"1536^2 but the {grid}^2 bench step resolved to "
-            f"{impl_used!r}")
+        # "auto" resolves per geometry. A fall-back TO XLA (Pallas compile
+        # failed at bench size) is reported honestly with a label — the
+        # XLA path is oracle-tested across the suite. The opposite
+        # direction (a Pallas kernel the gate never validated) stays a
+        # hard abort: that is exactly the fast-wrong-kernel outcome the
+        # gate exists to prevent.
+        if impl_used != "xla":
+            raise AssertionError(
+                f"impl mismatch: validated {validated[dtype_name]!r} at "
+                f"1536^2 but the {grid}^2 bench step resolved to "
+                f"{impl_used!r}, which was never oracle-checked")
+        print(f"  WARNING: validated {validated[dtype_name]!r} at 1536^2 "
+              f"but the {grid}^2 step fell back to 'xla'; "
+              "labeling result accordingly", file=sys.stderr)
+        impl_used = "xla-fallback"
     t = marginal_step_time(step, dict(space.values), s1=10, s2=60, reps=3)
 
     cups = grid * grid * substeps / t

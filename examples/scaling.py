@@ -1,0 +1,94 @@
+"""Scaling the simulation: fused kernels, deep halos, checkpointed runs.
+
+A tour of the performance and resilience surface on whatever devices
+this process has (TPU if available, else CPU):
+
+1. dense Diffusion with the fused multi-step Pallas kernel
+   (``substeps`` flow steps per HBM round-trip);
+2. a 2-D sharded run with deep halos (one depth-d ghost exchange per d
+   steps);
+3. a supervised, checkpointed run that survives an injected fault.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python examples/scaling.py
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from anywhere without installing
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import mpi_model_tpu as mm  # noqa: E402
+
+
+def main() -> None:
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    g = 2048 if on_tpu else 256
+    dtype = "bfloat16" if on_tpu else "float32"
+
+    # 1. fused multi-step kernel (serial / single chip)
+    space = mm.CellularSpace.create(g, g, 1.0, dtype=dtype)
+    model = mm.Model(mm.Diffusion(0.1), 64.0, 1.0)
+    from mpi_model_tpu.models.model import SerialExecutor
+
+    t0 = time.perf_counter()
+    out, rep = model.execute(space, SerialExecutor("auto", substeps=4))
+    print(f"1. {g}x{g} {dtype}, 64 steps, fused x4: "
+          f"{time.perf_counter() - t0:.2f}s, "
+          f"|drift|={rep.conservation_error():.2e}")
+
+    # 2. 2-D sharded with deep halos
+    cpus = jax.devices("cpu")
+    if len(cpus) >= 8:
+        from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh_2d
+
+        mesh = make_mesh_2d(2, 4, devices=cpus[:8])
+        s2 = mm.CellularSpace.create(256, 256, 1.0, dtype="float32")
+        with jax.default_device(cpus[0]):
+            out2, rep2 = mm.Model(mm.Diffusion(0.1), 16.0, 1.0).execute(
+                s2, ShardMapExecutor(mesh, halo_depth=4))
+        print(f"2. 256x256 over a 2x4 mesh, depth-4 halos "
+              f"(4 steps per exchange): ranks={rep2.comm_size}, "
+              f"|drift|={rep2.conservation_error():.2e}")
+    else:
+        print("2. (skipped: fewer than 8 CPU devices — start with "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8 to "
+              "see the deep-halo demo)")
+
+    # 3. supervised + checkpointed, with an injected transient fault
+    class Flaky:
+        comm_size = 1
+
+        def __init__(self):
+            self.calls = 0
+            self.inner = SerialExecutor()
+
+        def run_model(self, m, s, k):
+            self.calls += 1
+            if self.calls == 3:
+                raise RuntimeError("simulated preemption")
+            return self.inner.run_model(m, s, k)
+
+    s3 = mm.CellularSpace.create(64, 64, 1.0, dtype="float64")
+    m3 = mm.Model(mm.Diffusion(0.05), 20.0, 1.0)
+    with tempfile.TemporaryDirectory() as d:
+        from mpi_model_tpu.io import CheckpointManager
+
+        res = mm.supervised_run(m3, s3, CheckpointManager(d), steps=20,
+                                every=5, executor=Flaky())
+    want, _ = m3.execute(s3, steps=20)
+    np.testing.assert_array_equal(np.asarray(res.space.values["value"]),
+                                  np.asarray(want.values["value"]))
+    print(f"3. supervised run: {res.recovered_failures} failure recovered "
+          f"({res.events[0].detail}), final state bit-identical to an "
+          "uninterrupted run")
+
+
+if __name__ == "__main__":
+    main()
