@@ -42,22 +42,24 @@ def main() -> None:
     assert abs(v[19, 3] - 0.78) < 1e-12          # 1 - 0.22
     assert abs(v[18, 3] - (1 + 0.22 / 8)) < 1e-12
 
-    # sharded: 4 row stripes; cell (19,3) sits on stripe 0's LAST row,
-    # so its share crosses a device boundary via the ppermute halo —
-    # the reference's deliberate cross-rank default (Main.cpp:33)
+    # sharded: 5 row stripes of 20 rows — the reference's NWORKERS=5
+    # decomposition (Defines.hpp:7-8), where cell (19,3) sits on stripe
+    # 0's LAST row, so its share crosses a device boundary via the
+    # ppermute halo: the reference's deliberate cross-rank default
+    # (Main.cpp:33)
     devs = jax.devices("cpu")
-    if len(devs) >= 4:
+    if len(devs) >= 5:
         from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh
 
         out2, rep2 = model.execute(
-            space, ShardMapExecutor(make_mesh(4, devices=devs[:4])),
+            space, ShardMapExecutor(make_mesh(5, devices=devs[:5])),
             steps=1)
         np.testing.assert_allclose(np.asarray(out2.values["value"]), v,
                                    atol=1e-12)
         print(f"sharded x{rep2.comm_size}: identical to serial, "
               f"|drift|={rep2.conservation_error():.2e}")
     else:
-        print("(fewer than 4 CPU devices: start with XLA_FLAGS="
+        print("(fewer than 5 CPU devices: start with XLA_FLAGS="
               "--xla_force_host_platform_device_count=8 to see the "
               "sharded run)")
 
