@@ -126,10 +126,8 @@ def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
     """
     if nsteps < 1:
         raise ValueError(f"nsteps must be >= 1, got {nsteps}")
-    if nsteps > 1 and halo_operands is not None:
-        raise ValueError(
-            "multi-step fusion (nsteps > 1) is dense-mode only: the "
-            "sharded halo ring is one cell deep")
+    # halo mode supports nsteps > 1 when the exchanged ring is at least
+    # nsteps deep — validated by pallas_halo_step, which sees the ring
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -541,36 +539,43 @@ def _pallas_step(v: jax.Array, *, rate: float,
 
 @functools.partial(jax.jit,
                    static_argnames=("rate", "block", "offsets", "interpret",
-                                    "global_shape"))
+                                    "global_shape", "nsteps"))
 def _pallas_halo_step(v, n, s, w_col, e_col, nw, ne, sw, se, origin, *,
                       rate: float, block: tuple[int, int],
                       offsets: tuple[tuple[int, int], ...],
                       interpret: bool,
-                      global_shape: tuple[int, int]) -> jax.Array:
-    """Assemble the raw one-cell ghost ring into piece-granularity slabs
-    and run the halo-mode kernel (see ``_stencil_call``)."""
+                      global_shape: tuple[int, int],
+                      nsteps: int = 1) -> jax.Array:
+    """Assemble the raw depth-d ghost ring into piece-granularity slabs
+    and run the halo-mode kernel (see ``_stencil_call``). The ring depth
+    d = n.shape[0]; ghost cells sit INNERMOST in each slab (adjacent to
+    the shard interior), so the kernel's narrowed multi-step window
+    (which slices ``nsteps`` rings in from the slab side) reads real
+    ghost data whenever ``nsteps <= d``."""
     h, w = v.shape
     bh, bw = block
     SUB = _sublane(v.dtype)
     hr = min(SUB, bh)
     hc = min(LANE, bw)
-    # row slabs [hr, w]: ghost row innermost (adjacent to the interior)
-    nslab = jnp.pad(n, ((hr - 1, 0), (0, 0)))
-    sslab = jnp.pad(s, ((0, hr - 1), (0, 0)))
-    # column slabs [h + 2*hr, hc]: ghost column innermost, hr-row end
-    # caps holding the corner ghost cells
+    d = n.shape[0]
+    # row slabs [hr, w]: ghost rows innermost (adjacent to the interior)
+    nslab = jnp.pad(n, ((hr - d, 0), (0, 0)))
+    sslab = jnp.pad(s, ((0, hr - d), (0, 0)))
+    # column slabs [h + 2*hr, hc]: ghost columns innermost, hr-row end
+    # caps holding the d x d corner ghost blocks
     wfull = jnp.pad(
-        jnp.concatenate([jnp.pad(nw, ((hr - 1, 0), (0, 0))), w_col,
-                         jnp.pad(sw, ((0, hr - 1), (0, 0)))], axis=0),
-        ((0, 0), (hc - 1, 0)))
+        jnp.concatenate([jnp.pad(nw, ((hr - d, 0), (0, 0))), w_col,
+                         jnp.pad(sw, ((0, hr - d), (0, 0)))], axis=0),
+        ((0, 0), (hc - d, 0)))
     efull = jnp.pad(
-        jnp.concatenate([jnp.pad(ne, ((hr - 1, 0), (0, 0))), e_col,
-                         jnp.pad(se, ((0, hr - 1), (0, 0)))], axis=0),
-        ((0, 0), (0, hc - 1)))
+        jnp.concatenate([jnp.pad(ne, ((hr - d, 0), (0, 0))), e_col,
+                         jnp.pad(se, ((0, hr - d), (0, 0)))], axis=0),
+        ((0, 0), (0, hc - d)))
     origin = origin.astype(jnp.int32)
     return _stencil_call(v, (nslab, sslab, wfull, efull, origin),
                          rate=rate, block=block, offsets=offsets,
-                         interpret=interpret, global_shape=global_shape)
+                         interpret=interpret, global_shape=global_shape,
+                         nsteps=nsteps)
 
 
 def pallas_halo_step(
@@ -582,20 +587,26 @@ def pallas_halo_step(
     offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS,
     block: Optional[tuple[int, int]] = None,
     interpret: Optional[bool] = None,
+    nsteps: int = 1,
 ) -> jax.Array:
-    """Per-shard fused flow step consuming a ppermute ghost ring.
+    """Per-shard fused flow step(s) consuming a ppermute ghost ring.
 
     ``ring`` is ``parallel.halo.exchange_ring``'s output: ``n``/``s``
-    ``[1, w]``, ``w``/``e`` ``[h, 1]``, and four ``[1, 1]`` corners —
+    ``[d, w]``, ``w``/``e`` ``[h, d]``, and four ``[d, d]`` corners —
     zeros where the shard sits on the true grid boundary (ppermute's
     zero-fill). ``origin`` is the shard's global (row, col) offset
     (traced, from ``lax.axis_index``); ``global_shape`` the full grid
-    dims. Semantics: ``pallas_dense_step`` on the global grid, computed
-    shard-locally — the sharded realization of the reference's cross-rank
-    halo update (``/root/reference/src/Model.hpp:189-235``).
+    dims. With ``nsteps > 1`` (requires ring depth d >= nsteps), the
+    kernel fuses that many flow steps per invocation — combined with a
+    depth-d exchange this is one collective round AND one HBM round-trip
+    per d steps, the full config-5 architecture. Semantics:
+    ``pallas_dense_step`` on the global grid, computed shard-locally —
+    the sharded realization of the reference's cross-rank halo update
+    (``/root/reference/src/Model.hpp:189-235``).
     """
     offsets = check_offsets(offsets)
     h, w = values.shape
+    d = int(ring["n"].shape[0])
     if interpret is None:
         interpret = resolve_interpret(values)
     if block is None:
@@ -603,12 +614,23 @@ def pallas_halo_step(
         block = (_pick_block(h, 512, sub), _pick_block(w, 512, LANE))
     else:
         block = _validate_block(h, w, block)
+    hr = min(_sublane(values.dtype), block[0])
+    hc = min(LANE, block[1])
+    if d > min(hr, hc):
+        raise ValueError(
+            f"ring depth {d} exceeds the slab capacity min(hr={hr}, "
+            f"hc={hc}) for block {tuple(block)}")
+    if nsteps > d:
+        raise ValueError(
+            f"nsteps={nsteps} needs a ghost ring at least that deep; "
+            f"got depth {d} (exchange_ring(..., depth={nsteps}))")
     origin = jnp.asarray(origin, jnp.int32)
     return _pallas_halo_step(
         values, ring["n"], ring["s"], ring["w"], ring["e"],
         ring["nw"], ring["ne"], ring["sw"], ring["se"], origin,
         rate=float(rate), block=tuple(block), offsets=offsets,
-        interpret=bool(interpret), global_shape=tuple(global_shape))
+        interpret=bool(interpret), global_shape=tuple(global_shape),
+        nsteps=int(nsteps))
 
 
 def resolve_interpret(values=None) -> bool:

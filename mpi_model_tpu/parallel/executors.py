@@ -125,11 +125,6 @@ class ShardMapExecutor:
             raise ValueError(f"unknown halo mode {halo_mode!r}")
         if int(halo_depth) < 1:
             raise ValueError(f"halo_depth must be >= 1, got {halo_depth}")
-        if int(halo_depth) > 1 and step_impl == "pallas":
-            raise ValueError(
-                "halo_depth > 1 runs the XLA shard step (the Pallas halo "
-                "kernel consumes a one-cell ring); use step_impl='xla' or "
-                "'auto' with deep halos")
         self.mesh = mesh
         self.step_impl = step_impl
         #: DIAGNOSTIC knob for measuring halo cost (benchmarks/ladder.py's
@@ -139,11 +134,13 @@ class ShardMapExecutor:
         #: use for real runs.
         self.halo_mode = halo_mode
         #: halo_depth > 1 = DEEP-HALO execution: each collective round
-        #: exchanges a depth-d ghost ring, then d local steps run on the
-        #: padded shard (valid region shrinking one ring per step) —
-        #: collective rounds drop d-fold, the sharded analogue of the
-        #: Pallas kernel's multi-step fusion. Requires all flows to be
-        #: plain Diffusion (a point flow must fire between steps).
+        #: exchanges a depth-d ghost ring, then d local steps run on it —
+        #: collective rounds drop d-fold. On the XLA path the padded
+        #: shard shrinks one ring per step (any pointwise flows); on the
+        #: Pallas path the ring feeds d FUSED kernel steps (one
+        #: collective round and one HBM round-trip per d steps —
+        #: Diffusion-only). Point flows need halo_depth=1 (they must
+        #: fire between steps).
         self.halo_depth = int(halo_depth)
         self._cache: dict = {}
 
@@ -216,43 +213,37 @@ class ShardMapExecutor:
         from ..utils.tracing import get_tracer
 
         if self.halo_depth > 1:
-            runner = self._cache.get(key)
-            if runner is None:
+            entry = self._cache.get(key)
+            if entry is None:
+                # deep halos compose with the fused kernel: a depth-d
+                # ring feeds d fused steps per exchange (one collective
+                # round AND one HBM round-trip per d steps)
+                prunner, out = self._probe_pallas(
+                    model, space, num_steps, values, label="pallas-deep",
+                    fallback_name="the XLA deep-halo path")
+                if prunner is not None:
+                    self._cache[key] = prunner
+                    return out
                 with get_tracer().span("shardmap.build", impl="deep-halo",
                                        steps=num_steps,
                                        depth=self.halo_depth):
-                    runner = self._build_deep_runner(model, space, num_steps)
+                    runner = self._build_deep_runner(model, space,
+                                                     num_steps)
                 self._cache[key] = runner
+            else:
+                runner = entry
             return runner(values)
 
         entry = self._cache.get(key)
         if entry is None:
-            tracer = get_tracer()
-            rates = self._pallas_eligible_rates(model, space)
-            if rates is not None:
-                with tracer.span("shardmap.build", impl="pallas",
-                                 steps=num_steps):
-                    prunner = self._build_pallas_runner(
-                        model, space, num_steps, rates)
-                # first call traces+compiles; block_until_ready so
-                # async-dispatched device-side faults surface HERE, not
-                # in the caller after a broken runner got cached. On
-                # failure "auto" degrades to the XLA path (mirrors
-                # Model.make_step's fallback).
-                try:
-                    with tracer.span("shardmap.compile+first_run",
-                                     impl="pallas"):
-                        out = jax.block_until_ready(prunner(values))
-                except Exception as e:
-                    if self.step_impl == "pallas":
-                        raise
-                    warnings.warn(
-                        f"sharded Pallas step failed ({e!r}); falling back "
-                        "to the XLA pad-gather path", RuntimeWarning)
-                else:
-                    self._cache[key] = ("pallas", prunner)
-                    return out
-            with tracer.span("shardmap.build", impl="xla", steps=num_steps):
+            prunner, out = self._probe_pallas(
+                model, space, num_steps, values, label="pallas",
+                fallback_name="the XLA pad-gather path")
+            if prunner is not None:
+                self._cache[key] = ("pallas", prunner)
+                return out
+            with get_tracer().span("shardmap.build", impl="xla",
+                                   steps=num_steps):
                 entry = ("xla", self._build_runner(model, space, num_steps))
             self._cache[key] = entry
         kind, runner = entry
@@ -263,6 +254,38 @@ class ShardMapExecutor:
         const_of = {k: put(v) for k, v in const_of.items()}
         dyn_rate = {k: put(v) for k, v in dyn_rate.items()}
         return runner(values, const_of, dyn_rate)
+
+    def _probe_pallas(self, model, space, num_steps, values, *, label,
+                      fallback_name):
+        """Build + first-run the Pallas runner under one guard (BUILD-time
+        validation errors — e.g. a ring deeper than the slab capacity —
+        and compile/device faults degrade identically). Returns
+        ``(runner, first_output)`` on success; ``(None, None)`` when
+        ineligible or when ``"auto"`` should fall back; re-raises under
+        explicit ``step_impl="pallas"``. ``block_until_ready`` makes
+        async device faults surface HERE, not in the caller after a
+        broken runner got cached."""
+        from ..utils.tracing import get_tracer
+
+        rates = self._pallas_eligible_rates(model, space)
+        if rates is None:
+            return None, None
+        tracer = get_tracer()
+        try:
+            with tracer.span("shardmap.build", impl=label,
+                             steps=num_steps, depth=self.halo_depth):
+                prunner = self._build_pallas_runner(
+                    model, space, num_steps, rates)
+            with tracer.span("shardmap.compile+first_run", impl=label):
+                out = jax.block_until_ready(prunner(values))
+        except Exception as e:
+            if self.step_impl == "pallas":
+                raise
+            warnings.warn(
+                f"{label} step failed ({e!r}); falling back to "
+                f"{fallback_name}", RuntimeWarning)
+            return None, None
+        return prunner, out
 
     def _build_deep_runner(self, model, space: CellularSpace,
                            num_steps: int):
@@ -454,7 +477,10 @@ class ShardMapExecutor:
                              num_steps: int, rates: dict):
         """Per-shard fused Pallas kernel fed by the ppermute ghost ring —
         the config-5 architecture (SURVEY §7 'Pallas at 16384²'): the
-        fast kernel and the distributed runtime in one compiled step."""
+        fast kernel and the distributed runtime in one compiled step.
+        With ``halo_depth = d > 1`` the ring is exchanged d cells deep
+        and the kernel fuses d flow steps per invocation — one
+        collective round AND one HBM round-trip per d steps."""
         from jax import lax
 
         from ..ops.pallas_stencil import pallas_halo_step
@@ -471,6 +497,11 @@ class ShardMapExecutor:
         gshape = (space.dim_x, space.dim_y)
         offsets = model.offsets
         spec = grid_spec(mesh)
+        depth = self.halo_depth
+        if depth > (local_h if ay is None else min(local_h, local_w)):
+            raise ValueError(
+                f"halo_depth={depth} exceeds the shard extent "
+                f"({local_h}x{local_w})")
 
         def shard_fn(values):
             row0 = lax.axis_index(ax) * np.int32(local_h)
@@ -478,18 +509,30 @@ class ShardMapExecutor:
                     else jnp.int32(0))
             origin = jnp.stack([row0, col0]).astype(jnp.int32)
 
-            def body(c, _):
+            def chunk(c, ns):
+                """ns fused steps after one depth-``ns`` exchange (the
+                remainder chunk ships only the rings it consumes)."""
                 new = dict(c)
                 for attr, rate in rates.items():
                     if rate == 0.0:
                         continue
-                    ring = (zero_ring(c[attr]) if self.halo_mode == "zero"
-                            else exchange_ring(c[attr], ax, nx, ay, ny))
+                    ring = (zero_ring(c[attr], ns)
+                            if self.halo_mode == "zero"
+                            else exchange_ring(c[attr], ax, nx, ay, ny,
+                                               depth=ns))
                     new[attr] = pallas_halo_step(
-                        c[attr], ring, origin, gshape, rate, offsets)
-                return new, None
+                        c[attr], ring, origin, gshape, rate, offsets,
+                        nsteps=ns)
+                return new
 
-            out, _ = lax.scan(body, values, None, length=num_steps)
+            q, r = divmod(num_steps, depth)
+            out = values
+            if q:
+                def body(carry, _):
+                    return chunk(carry, depth), None
+                out, _ = lax.scan(body, out, None, length=q)
+            if r:
+                out = chunk(out, r)
             return out
 
         # check_vma=False: pallas_call's out_shape carries no

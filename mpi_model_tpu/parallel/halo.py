@@ -82,25 +82,29 @@ def pad_with_halo_2d(local: jax.Array, ax_name: str, ay_name: str,
 
 
 def exchange_ring(local: jax.Array, ax_name: str, nx: int,
-                  ay_name: str = None, ny: int = 1) -> dict:
-    """One-cell ghost ring for a shard as SEPARATE thin arrays (for the
-    Pallas halo kernel, which needs aligned DMA sources, not a
-    concatenated [h+2, w+2] padded copy): ``n``/``s`` [1, w], ``w``/``e``
-    [h, 1], corners [1, 1]. Zeros at true grid edges (ppermute
-    zero-fill / no mesh axis). Corner cells ride the standard two-stage
-    exchange: the column halos are swapped first, then row strips
-    *augmented with those columns' end cells* are swapped, so the
-    diagonal neighbor's corner cell arrives without diagonal permutes."""
+                  ay_name: str = None, ny: int = 1,
+                  depth: int = 1) -> dict:
+    """Depth-``d`` ghost ring for a shard as SEPARATE thin arrays (for
+    the Pallas halo kernel, which needs aligned DMA sources, not a
+    concatenated padded copy): ``n``/``s`` [d, w], ``w``/``e`` [h, d],
+    corners [d, d]. Zeros at true grid edges (ppermute zero-fill / no
+    mesh axis). Corner blocks ride the standard two-stage exchange: the
+    column halos are swapped first, then row slabs *augmented with those
+    columns' end strips* are swapped, so the diagonal neighbor's d×d
+    corner arrives without diagonal permutes. ``depth > 1`` funds
+    multi-step fusion inside the per-shard kernel (one exchange per
+    ``depth`` fused steps)."""
     h, w = local.shape
+    d = depth
     if ay_name is not None and ny > 1:
-        left, right = exchange_halo_1d(local, ay_name, ny, axis=1)
+        left, right = exchange_halo_1d(local, ay_name, ny, axis=1, depth=d)
     else:
-        left = jnp.zeros((h, 1), local.dtype)
-        right = jnp.zeros((h, 1), local.dtype)
+        left = jnp.zeros((h, d), local.dtype)
+        right = jnp.zeros((h, d), local.dtype)
     top_strip = jnp.concatenate(
-        [left[:1], local[:1], right[:1]], axis=1)       # [1, w+2]
+        [left[:d], local[:d], right[:d]], axis=1)       # [d, w+2d]
     bot_strip = jnp.concatenate(
-        [left[-1:], local[-1:], right[-1:]], axis=1)
+        [left[-d:], local[-d:], right[-d:]], axis=1)
     if nx > 1:
         nfull = lax.ppermute(bot_strip, ax_name, _fwd_perm(nx))
         sfull = lax.ppermute(top_strip, ax_name, _bwd_perm(nx))
@@ -108,25 +112,26 @@ def exchange_ring(local: jax.Array, ax_name: str, nx: int,
         nfull = jnp.zeros_like(top_strip)
         sfull = jnp.zeros_like(bot_strip)
     return {
-        "n": nfull[:, 1:w + 1], "s": sfull[:, 1:w + 1],
+        "n": nfull[:, d:w + d], "s": sfull[:, d:w + d],
         "w": left, "e": right,
-        "nw": nfull[:, 0:1], "ne": nfull[:, w + 1:w + 2],
-        "sw": sfull[:, 0:1], "se": sfull[:, w + 1:w + 2],
+        "nw": nfull[:, 0:d], "ne": nfull[:, w + d:w + 2 * d],
+        "sw": sfull[:, 0:d], "se": sfull[:, w + d:w + 2 * d],
     }
 
 
-def zero_ring(local: jax.Array) -> dict:
+def zero_ring(local: jax.Array, depth: int = 1) -> dict:
     """An all-zero ghost ring shaped like ``exchange_ring``'s output —
     the no-traffic stand-in used when measuring halo cost (and the
     boundary condition of a standalone full grid)."""
     h, w = local.shape
+    d = depth
 
     def z(s):
         return jnp.zeros(s, local.dtype)
 
-    return {"n": z((1, w)), "s": z((1, w)), "w": z((h, 1)), "e": z((h, 1)),
-            "nw": z((1, 1)), "ne": z((1, 1)), "sw": z((1, 1)),
-            "se": z((1, 1))}
+    return {"n": z((d, w)), "s": z((d, w)), "w": z((h, d)), "e": z((h, d)),
+            "nw": z((d, d)), "ne": z((d, d)), "sw": z((d, d)),
+            "se": z((d, d))}
 
 
 def gather_from_padded(padded: jax.Array,

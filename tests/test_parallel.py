@@ -490,3 +490,60 @@ def test_model_rectangular_deep_halo_passthrough(eight_devices):
     assert report.comm_size == 8
     want = serial_result(Model(Diffusion(0.1)), space, 6)
     np.testing.assert_array_equal(out.to_numpy()["value"], want)
+
+
+# -- deep halos composed with the fused Pallas kernel (config 5, complete) --
+
+@pytest.mark.parametrize("meshname", ["mesh1d", "mesh2d"])
+@pytest.mark.parametrize("depth", [2, 4])
+def test_shardmap_pallas_deep_halo_matches_oracle(meshname, depth, request):
+    """halo_depth=d on the Pallas path: a depth-d ppermute ring feeds d
+    fused kernel steps per exchange — one collective round AND one HBM
+    round-trip per d steps (the complete config-5 architecture),
+    golden-matched against the composed oracle including remainder
+    chunks (10 = 2x4+2) and 2-D corner blocks."""
+    from mpi_model_tpu.oracle import dense_flow_step_np
+
+    mesh = request.getfixturevalue(meshname)
+    rng = np.random.default_rng(11)
+    v0 = rng.uniform(0.5, 2.0, (32, 256)).astype(np.float32)
+    space = CellularSpace.create(32, 256, 1.0, dtype=jnp.float32).with_values(
+        {"value": jnp.asarray(v0)})
+    want = v0.astype(np.float64)
+    for _ in range(10):
+        want = dense_flow_step_np(want, 0.13)
+    out, rep = Model(Diffusion(0.13), 10.0, 1.0).execute(
+        space, ShardMapExecutor(mesh, step_impl="pallas", halo_depth=depth),
+        steps=10)
+    np.testing.assert_allclose(
+        np.asarray(out.values["value"], np.float64), want,
+        rtol=1e-4, atol=1e-4)
+    assert rep.conservation_error() < 1e-2  # f32 rounding only
+
+
+def test_shardmap_pallas_deep_halo_depth_beyond_slab_falls_back(mesh1d):
+    """A ring deeper than the kernel's slab capacity (f32: hr=8 rows)
+    but within the shard extent: explicit pallas raises; 'auto' degrades
+    to the XLA deep-halo path, which handles any depth up to the shard —
+    and still matches serial bitwise."""
+    import warnings as _w
+
+    # shard rows = 256/4 = 64 >= depth 9, but f32 slab capacity hr=8 < 9
+    rng = np.random.default_rng(3)
+    space = CellularSpace.create(256, 128, 1.0, dtype=jnp.float64
+                                 ).with_values(
+        {"value": jnp.asarray(rng.uniform(0.5, 2.0, (256, 128)))})
+    model = Model(Diffusion(0.1), 18.0, 1.0)
+    # steps >= depth so a FULL-depth chunk compiles (a shorter run's
+    # remainder chunk only exchanges the rings it consumes and is valid)
+    with pytest.raises(ValueError):
+        model.execute(space, ShardMapExecutor(mesh1d, step_impl="pallas",
+                                              halo_depth=9), steps=18)
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        out, _ = model.execute(
+            space, ShardMapExecutor(mesh1d, step_impl="auto", halo_depth=9),
+            steps=18, check_conservation=False)
+    want, _ = model.execute(space, steps=18, check_conservation=False)
+    np.testing.assert_array_equal(np.asarray(out.values["value"]),
+                                  np.asarray(want.values["value"]))
