@@ -181,6 +181,13 @@ class Model:
             rates[f.attr] = rates.get(f.attr, 0.0) + f.flow_rate
         return rates
 
+    @staticmethod
+    def pallas_dtype_ok(space: CellularSpace) -> bool:
+        """Pallas kernels compute in f32 internally; f64 grids stay on
+        the XLA path so "auto" never silently downgrades the oracle-tier
+        precision (f32/bf16/f16 are eligible)."""
+        return jnp.dtype(space.dtype).itemsize <= 4
+
     def make_step(self, space: CellularSpace, impl: str = "xla",
                   substeps: int = 1) -> Callable[[Values], Values]:
         """Build the pure per-step function for this space's geometry.
@@ -246,7 +253,11 @@ class Model:
                 for f in field_flows) and bool(field_flows)
             # substeps > 1 fuses steps inside the kernel, so a (local)
             # point flow — which must fire between sub-steps — disqualifies
+            # f64 grids stay on the XLA path: the Pallas kernels compute
+            # in f32 internally, and "auto" must never silently downgrade
+            # the oracle-tier precision a user asked for
             base_ok = (not space.is_partition
+                       and self.pallas_dtype_ok(space)
                        and (substeps == 1 or not pt_by_attr))
             eligible = rates is not None and base_ok
             field_eligible = all_pointwise and base_ok
@@ -254,10 +265,12 @@ class Model:
                 raise ValueError(
                     "impl='pallas' requires all field flows to be "
                     "POINTWISE (Diffusion/Coupled/...) on a full "
-                    "(non-partition) grid (and no point flows when "
-                    "substeps > 1); got "
+                    "(non-partition) f32/bf16 grid — the kernel computes "
+                    "in f32, so f64 stays on the XLA path — (and no "
+                    "point flows when substeps > 1); got "
                     f"flows={[type(f).__name__ for f in self.flows]}, "
                     f"is_partition={space.is_partition}, "
+                    f"dtype={space.dtype}, "
                     f"substeps={substeps}. Use impl='xla' "
                     "or 'auto'; for sharded DIFFUSION models use "
                     "ShardMapExecutor(mesh, step_impl='pallas') — the "

@@ -187,13 +187,19 @@ class ShardMapExecutor:
             return None
         rates = model.pallas_rates()
         has_point = any(isinstance(f, PointFlow) for f in model.flows)
-        ok = rates is not None and not has_point and not space.is_partition
+        # f64 shards stay on the XLA shard step: the halo kernel computes
+        # in f32 internally (no silent precision downgrade under "auto")
+        ok = (rates is not None and not has_point
+              and not space.is_partition and model.pallas_dtype_ok(space))
         if self.step_impl == "pallas" and not ok:
             raise ValueError(
                 "step_impl='pallas' requires all flows to be plain "
-                "Diffusion on a full (non-partition) grid; got "
+                "Diffusion on a full (non-partition) f32/bf16 grid (the "
+                "kernel computes in f32; f64 runs the XLA shard step); "
+                "got "
                 f"flows={[type(f).__name__ for f in model.flows]}, "
-                f"is_partition={space.is_partition}. Use 'xla' or 'auto'.")
+                f"is_partition={space.is_partition}, "
+                f"dtype={space.dtype}. Use 'xla' or 'auto'.")
         return rates if ok else None
 
     def run_model(self, model, space: CellularSpace, num_steps: int) -> Values:

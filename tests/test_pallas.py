@@ -543,3 +543,14 @@ def test_property_random_config_matches_oracle(seed):
         err_msg=f"shape=({h},{w}) block=({bh},{bw}) ns={ns} "
                 f"rate={rate:.3f} offsets={'moore' if len(offs)==8 else 'vn'}")
     assert abs(got.sum() - v.astype(np.float64).sum()) < 1e-2
+
+
+def test_auto_keeps_f64_on_xla_path():
+    """f64 grids must never be silently downgraded: the Pallas kernels
+    compute in f32 internally, so 'auto' keeps the XLA path and explicit
+    'pallas' refuses."""
+    space = CellularSpace.create(32, 32, 1.0, dtype=jnp.float64)
+    model = Model(Diffusion(0.1), 1.0, 1.0)
+    assert model.make_step(space, impl="auto").impl == "xla"
+    with pytest.raises(ValueError, match="f32/bf16"):
+        model.make_step(space, impl="pallas")
