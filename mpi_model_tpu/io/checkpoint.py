@@ -184,10 +184,18 @@ def run_checkpointed(model, space: CellularSpace, manager: CheckpointManager,
     supervisor's in-band health checks (drift is bounded against the
     RUN-global initial totals, and a violation surfaces as
     ``SimulationFailure`` wrapping the health report)."""
-    from ..resilience import supervised_run
+    from ..resilience import SimulationFailure, supervised_run
 
-    res = supervised_run(model, space, manager, steps=steps, every=every,
-                         max_failures=0, executor=executor,
-                         health_checks=check_conservation,
-                         tolerance=tolerance, rtol=rtol)
+    try:
+        res = supervised_run(model, space, manager, steps=steps,
+                             every=every, max_failures=0, executor=executor,
+                             health_checks=check_conservation,
+                             tolerance=tolerance, rtol=rtol)
+    except SimulationFailure as e:
+        # with recovery disabled there is exactly one underlying failure;
+        # surface it with its original type (callers catch e.g.
+        # ConservationError/HealthError, not the supervisor's wrapper)
+        if e.__cause__ is not None:
+            raise e.__cause__
+        raise
     return res.space, res.step, res.report

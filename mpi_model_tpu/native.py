@@ -71,8 +71,13 @@ def load_library():
         build_native(force=True)
         fd, tmp = tempfile.mkstemp(suffix=".so")
         os.close(fd)
-        shutil.copy2(_LIB_PATH, tmp)
-        lib = ctypes.CDLL(tmp)
+        try:
+            shutil.copy2(_LIB_PATH, tmp)
+            lib = ctypes.CDLL(tmp)
+        finally:
+            # the dlopen mapping survives the unlink on Linux; without
+            # this every affected process leaks one temp .so on disk
+            os.unlink(tmp)
         if not hasattr(lib, "mmtpu_selftest_recv_timeout"):
             raise RuntimeError(
                 "libmmtpu.so is stale and rebuilding did not refresh it; "

@@ -1,7 +1,9 @@
 from .flow import Coupled, Diffusion, Exponencial, Flow, PointFlow, build_outflow
 from .pallas_stencil import (
     PallasDiffusionStep,
+    PallasFieldStep,
     pallas_dense_step,
+    pallas_field_halo_step,
     pallas_halo_step,
 )
 from .stencil import flow_step, point_flow_step, shift2d, transport
@@ -19,5 +21,7 @@ __all__ = [
     "point_flow_step",
     "pallas_dense_step",
     "pallas_halo_step",
+    "pallas_field_halo_step",
     "PallasDiffusionStep",
+    "PallasFieldStep",
 ]

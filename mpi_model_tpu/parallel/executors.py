@@ -63,6 +63,8 @@ class AutoShardedExecutor:
     def __init__(self, mesh: Mesh, spec: Optional[P] = None):
         self.mesh = mesh
         self.spec = grid_spec(mesh) if spec is None else spec
+        #: GSPMD always runs the XLA step (reported by the CLI/bench)
+        self.last_impl: Optional[str] = "xla"
         self._cache: dict = {}
 
     @property
@@ -108,11 +110,13 @@ class ShardMapExecutor:
 
     ``step_impl`` selects the per-shard field-flow kernel, mirroring
     ``SerialExecutor``: ``"xla"`` (pad→gather stencil, works for every
-    flow), ``"pallas"`` (the fused halo-mode kernel,
-    ``ops.pallas_stencil.pallas_halo_step``, consuming the ppermute ghost
-    ring — requires every flow to be a plain ``Diffusion``; raises
-    otherwise), or ``"auto"`` (pallas when eligible and its compile
-    succeeds, else xla).
+    flow), ``"pallas"`` (the fused halo-mode kernels consuming the
+    ppermute ghost ring — the specialized Diffusion kernel when every
+    flow is a plain ``Diffusion``, else the general multi-channel field
+    kernel for any POINTWISE flows (Coupled/user); requires no point
+    flows and an f32/bf16 non-partition grid, raises otherwise), or
+    ``"auto"`` (pallas when eligible and its compile succeeds, else
+    xla).
     """
 
     def __init__(self, mesh: Mesh, step_impl: str = "xla",
@@ -142,6 +146,9 @@ class ShardMapExecutor:
         #: Diffusion-only). Point flows need halo_depth=1 (they must
         #: fire between steps).
         self.halo_depth = int(halo_depth)
+        #: kernel the last ``run_model`` actually used ("pallas"/"xla"),
+        #: after any "auto" fallback — reported by the CLI/bench
+        self.last_impl: Optional[str] = None
         self._cache: dict = {}
 
     @property
@@ -179,28 +186,45 @@ class ShardMapExecutor:
 
     # -- execution ---------------------------------------------------------
 
-    def _pallas_eligible_rates(self, model, space: CellularSpace):
-        """attr→rate map when the fused halo kernel applies (every flow a
-        plain Diffusion, full grid); None → use the XLA path. Raises for
-        an explicit ``step_impl='pallas'`` that can't be honored."""
+    def _pallas_plan(self, model, space: CellularSpace):
+        """Which fused halo kernel applies: ``("diffusion", rates)`` when
+        every flow is a plain Diffusion (the specialized kernel with the
+        closed-form interior fast path), ``("field", flows)`` when every
+        field flow is pointwise (the general multi-channel kernel —
+        Coupled/user flows), or None → the XLA shard step. Raises for an
+        explicit ``step_impl='pallas'`` that can't be honored."""
         if self.step_impl == "xla":
             return None
-        rates = model.pallas_rates()
         has_point = any(isinstance(f, PointFlow) for f in model.flows)
-        # f64 shards stay on the XLA shard step: the halo kernel computes
-        # in f32 internally (no silent precision downgrade under "auto")
-        ok = (rates is not None and not has_point
-              and not space.is_partition and model.pallas_dtype_ok(space))
-        if self.step_impl == "pallas" and not ok:
+        # f64 shards stay on the XLA shard step: the halo kernels compute
+        # in f32 internally (no silent precision downgrade under "auto");
+        # point flows must fire between steps, which the fused kernels
+        # cannot interleave
+        base_ok = (not has_point and not space.is_partition
+                   and model.pallas_dtype_ok(space))
+        if base_ok:
+            rates = model.pallas_rates()
+            # empty/all-zero rates = no field transport: nothing for the
+            # kernel to do — don't claim "pallas" ran (see make_step)
+            if rates and any(r != 0.0 for r in rates.values()):
+                return ("diffusion", rates)
+            field_flows = tuple(f for f in model.flows
+                                if not isinstance(f, PointFlow))
+            if field_flows and all(
+                    getattr(f, "footprint", "unknown") == "pointwise"
+                    for f in field_flows):
+                return ("field", field_flows)
+        if self.step_impl == "pallas":
             raise ValueError(
-                "step_impl='pallas' requires all flows to be plain "
-                "Diffusion on a full (non-partition) f32/bf16 grid (the "
-                "kernel computes in f32; f64 runs the XLA shard step); "
+                "step_impl='pallas' requires all field flows to be "
+                "POINTWISE (Diffusion/Coupled/...) on a full "
+                "(non-partition) f32/bf16 grid with no point flows (the "
+                "kernels compute in f32; f64 runs the XLA shard step); "
                 "got "
                 f"flows={[type(f).__name__ for f in model.flows]}, "
                 f"is_partition={space.is_partition}, "
                 f"dtype={space.dtype}. Use 'xla' or 'auto'.")
-        return rates if ok else None
+        return None
 
     def run_model(self, model, space: CellularSpace, num_steps: int) -> Values:
         _check_divisible(space, self.mesh)
@@ -228,16 +252,21 @@ class ShardMapExecutor:
                     model, space, num_steps, values, label="pallas-deep",
                     fallback_name="the XLA deep-halo path")
                 if prunner is not None:
-                    self._cache[key] = prunner
+                    self._cache[key] = ("pallas", prunner)
+                    self.last_impl = "pallas"
                     return out
                 with get_tracer().span("shardmap.build", impl="deep-halo",
                                        steps=num_steps,
                                        depth=self.halo_depth):
                     runner = self._build_deep_runner(model, space,
                                                      num_steps)
-                self._cache[key] = runner
-            else:
-                runner = entry
+                entry = ("xla", runner)
+                self._cache[key] = entry
+            kind, runner = entry
+            #: the kernel the last run actually used (after any "auto"
+            #: fallback) — the CLI/bench report it so a user never
+            #: believes they measured a configuration that never ran
+            self.last_impl = kind
             return runner(values)
 
         entry = self._cache.get(key)
@@ -247,12 +276,14 @@ class ShardMapExecutor:
                 fallback_name="the XLA pad-gather path")
             if prunner is not None:
                 self._cache[key] = ("pallas", prunner)
+                self.last_impl = "pallas"
                 return out
             with get_tracer().span("shardmap.build", impl="xla",
                                    steps=num_steps):
                 entry = ("xla", self._build_runner(model, space, num_steps))
             self._cache[key] = entry
         kind, runner = entry
+        self.last_impl = kind
         if kind == "pallas":
             return runner(values)
 
@@ -273,15 +304,15 @@ class ShardMapExecutor:
         broken runner got cached."""
         from ..utils.tracing import get_tracer
 
-        rates = self._pallas_eligible_rates(model, space)
-        if rates is None:
+        plan = self._pallas_plan(model, space)
+        if plan is None:
             return None, None
         tracer = get_tracer()
         try:
             with tracer.span("shardmap.build", impl=label,
                              steps=num_steps, depth=self.halo_depth):
                 prunner = self._build_pallas_runner(
-                    model, space, num_steps, rates)
+                    model, space, num_steps, plan)
             with tracer.span("shardmap.compile+first_run", impl=label):
                 out = jax.block_until_ready(prunner(values))
         except Exception as e:
@@ -480,19 +511,31 @@ class ShardMapExecutor:
         return jax.jit(sharded)
 
     def _build_pallas_runner(self, model, space: CellularSpace,
-                             num_steps: int, rates: dict):
+                             num_steps: int, plan: tuple):
         """Per-shard fused Pallas kernel fed by the ppermute ghost ring —
         the config-5 architecture (SURVEY §7 'Pallas at 16384²'): the
         fast kernel and the distributed runtime in one compiled step.
-        With ``halo_depth = d > 1`` the ring is exchanged d cells deep
-        and the kernel fuses d flow steps per invocation — one
-        collective round AND one HBM round-trip per d steps."""
+        ``plan`` selects the kernel (``_pallas_plan``): ``"diffusion"``
+        runs the specialized per-channel kernel, ``"field"`` the general
+        multi-channel kernel (Coupled/user pointwise flows — ALL
+        channels exchange rings, since outflows read modulators on ghost
+        cells). With ``halo_depth = d > 1`` the ring is exchanged d
+        cells deep and the kernel fuses d flow steps per invocation —
+        one collective round AND one HBM round-trip per d steps."""
         from jax import lax
 
-        from ..ops.pallas_stencil import pallas_halo_step
+        from ..ops.pallas_stencil import (
+            mesh_interpret, pallas_field_halo_step, pallas_halo_step,
+        )
         from .halo import exchange_ring, zero_ring
 
+        kind, payload = plan
         mesh = self.mesh
+        # resolve interpret from the MESH platform, not ambient config:
+        # inside shard_map the values are tracers, and the default
+        # backend/device can disagree with where the mesh actually runs
+        # (round-3 VERDICT weak #1 — both failure directions)
+        interpret = mesh_interpret(mesh)
         names = mesh.axis_names
         ax = names[0]
         ay = names[1] if len(names) > 1 else None
@@ -509,27 +552,37 @@ class ShardMapExecutor:
                 f"halo_depth={depth} exceeds the shard extent "
                 f"({local_h}x{local_w})")
 
+        def ring_of(z, ns):
+            return (zero_ring(z, ns) if self.halo_mode == "zero"
+                    else exchange_ring(z, ax, nx, ay, ny, depth=ns))
+
         def shard_fn(values):
             row0 = lax.axis_index(ax) * np.int32(local_h)
             col0 = (lax.axis_index(ay) * np.int32(local_w) if ay
                     else jnp.int32(0))
             origin = jnp.stack([row0, col0]).astype(jnp.int32)
 
-            def chunk(c, ns):
-                """ns fused steps after one depth-``ns`` exchange (the
-                remainder chunk ships only the rings it consumes)."""
-                new = dict(c)
-                for attr, rate in rates.items():
-                    if rate == 0.0:
-                        continue
-                    ring = (zero_ring(c[attr], ns)
-                            if self.halo_mode == "zero"
-                            else exchange_ring(c[attr], ax, nx, ay, ny,
-                                               depth=ns))
-                    new[attr] = pallas_halo_step(
-                        c[attr], ring, origin, gshape, rate, offsets,
-                        nsteps=ns)
-                return new
+            if kind == "diffusion":
+                def chunk(c, ns):
+                    """ns fused steps after one depth-``ns`` exchange
+                    (the remainder chunk ships only the rings it
+                    consumes); flow-less channels never exchange."""
+                    new = dict(c)
+                    for attr, rate in payload.items():
+                        if rate == 0.0:
+                            continue
+                        new[attr] = pallas_halo_step(
+                            c[attr], ring_of(c[attr], ns), origin, gshape,
+                            rate, offsets, interpret=interpret, nsteps=ns)
+                    return new
+            else:
+                def chunk(c, ns):
+                    """One depth-``ns`` exchange of EVERY channel, then
+                    ns fused multi-channel steps in one kernel call."""
+                    rings = {k: ring_of(v, ns) for k, v in c.items()}
+                    return pallas_field_halo_step(
+                        c, rings, origin, gshape, payload, offsets,
+                        interpret=interpret, nsteps=ns)
 
             q, r = divmod(num_steps, depth)
             out = values

@@ -132,11 +132,17 @@ def broadcast_str(s: str, max_len: int = 256) -> str:
     derived from per-process state — e.g. a wall-clock-stamped output
     filename."""
     import jax
+    raw = s.encode("utf-8")
+    if len(raw) > max_len:
+        # truncating would silently corrupt a cluster-wide value (e.g. a
+        # long output path used by every process)
+        raise ValueError(
+            f"broadcast_str: string is {len(raw)} bytes UTF-8, exceeding "
+            f"max_len={max_len}; pass a larger max_len")
     if jax.process_count() == 1:
         return s
     from jax.experimental import multihost_utils
     buf = np.zeros(max_len, np.uint8)
-    raw = s.encode("utf-8")[:max_len]
     buf[:len(raw)] = np.frombuffer(raw, np.uint8)
     out = multihost_utils.broadcast_one_to_all(buf)
     return bytes(np.asarray(out)).rstrip(b"\x00").decode("utf-8")

@@ -132,7 +132,13 @@ def check_health(space: CellularSpace,
                 "(NaN/Inf divergence)")
             continue  # totals of a non-finite channel are meaningless
         if initial_totals is not None and threshold is not None:
-            drift = abs(float(total) - initial_totals[name])
+            baseline = initial_totals.get(name)
+            if baseline is None:
+                # a channel added after the baseline was captured (e.g. a
+                # resumed run whose checkpoint predates it) has no drift
+                # reference — skip rather than KeyError mid-health-check
+                continue
+            drift = abs(float(total) - baseline)
             if drift > threshold:
                 problems.append(
                     f"channel {name!r}: conservation drift {drift:.3e} > "

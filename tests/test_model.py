@@ -195,3 +195,13 @@ def test_partition_descriptor_roundtrip():
     # remainder-safe (reference requires divisibility; we don't)
     parts = row_partitions(103, 7, 4)
     assert sum(q.height for q in parts) == 103
+
+
+def test_zero_steps_is_identity():
+    """steps=0 (a valid non-negative count per the CLI contract) must
+    return the space unchanged, not crash building the impl report."""
+    space = CellularSpace.create(8, 8, 1.0, dtype=jnp.float64)
+    out, report = Model(Diffusion(0.1)).execute(space, steps=0)
+    np.testing.assert_array_equal(out.to_numpy()["value"],
+                                  space.to_numpy()["value"])
+    assert report.steps == 0

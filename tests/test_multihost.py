@@ -31,3 +31,12 @@ def test_two_process_cpu_dryrun():
     line = multihost.dryrun_two_process(port=29791)
     assert "MASTER ok: procs=2" in line
     assert "conservation_err=0.000e+00" in line
+
+
+def test_broadcast_str_rejects_overlong():
+    """Silent truncation would corrupt a cluster-wide value; overlong
+    strings are an error (single- and multi-process: the length check
+    runs before the process-count fast path)."""
+    assert multihost.broadcast_str("short") == "short"
+    with pytest.raises(ValueError, match="max_len"):
+        multihost.broadcast_str("x" * 300)

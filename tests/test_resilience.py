@@ -189,3 +189,26 @@ def test_clean_run_has_no_events_and_matches_plain_execute():
     np.testing.assert_array_equal(
         np.asarray(res.space.values["value"]), expected_final(model, space))
     assert res.report is not None and res.report.steps == 2  # last chunk
+
+
+def test_check_health_skips_channel_without_baseline():
+    """A channel added after the baseline was captured (resume from an
+    older checkpoint) must not KeyError the health check."""
+    space = make_space()
+    two = space.with_values({**space.values,
+                             "extra": jnp.ones_like(space.values["value"])})
+    init = {"value": float(space.total("value"))}  # no "extra" baseline
+    assert check_health(two, init, threshold=1e-6) == []
+
+
+def test_run_checkpointed_surfaces_original_exception(tmp_path):
+    """With recovery disabled, run_checkpointed re-raises the underlying
+    failure with its ORIGINAL type, not the supervisor's wrapper."""
+    from mpi_model_tpu.io import run_checkpointed
+
+    space = make_space()
+    model = make_model()
+    ex = FaultyExecutor(fail_calls={0})
+    with pytest.raises(RuntimeError, match="injected device fault"):
+        run_checkpointed(model, space, CheckpointManager(str(tmp_path)),
+                         steps=4, every=2, executor=ex)
