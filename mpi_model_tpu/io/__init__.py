@@ -5,6 +5,11 @@ from .checkpoint import (
     run_checkpointed,
     save_checkpoint,
 )
+from .sharded import (
+    is_sharded_checkpoint,
+    load_checkpoint_sharded,
+    save_checkpoint_sharded,
+)
 from .output import (
     merge_dumps,
     output_filename,
@@ -19,6 +24,9 @@ __all__ = [
     "save_checkpoint",
     "load_checkpoint",
     "run_checkpointed",
+    "save_checkpoint_sharded",
+    "load_checkpoint_sharded",
+    "is_sharded_checkpoint",
     "partition_dump_lines",
     "write_partition_dump",
     "merge_dumps",

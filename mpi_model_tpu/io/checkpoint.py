@@ -124,47 +124,85 @@ def load_checkpoint(path: str) -> Checkpoint:
 class CheckpointManager:
     """Periodic checkpoints in one directory, pruned to the newest ``keep``.
 
-    File layout: ``{prefix}_{step:010d}.npz`` — the step counter is the
-    checkpoint identity, so ``latest()`` is a filename sort, not a mtime
-    race.
+    File layout: ``{prefix}_{step:010d}.npz`` (dense, the default) or a
+    ``{prefix}_{step:010d}.ckpt`` directory (``layout="sharded"``, the
+    O(shard) per-process format — ``io.sharded``). The step counter is
+    the checkpoint identity, so ``latest()`` is a filename sort, not a
+    mtime race; ``restore`` auto-detects the layout on disk, so a run
+    can switch layouts and still resume.
     """
 
-    def __init__(self, directory: str, keep: int = 3, prefix: str = "ckpt"):
+    def __init__(self, directory: str, keep: int = 3, prefix: str = "ckpt",
+                 layout: str = "full"):
+        if layout not in ("full", "sharded"):
+            raise ValueError(f"layout must be 'full' or 'sharded': {layout!r}")
         self.directory = directory
         self.keep = int(keep)
         self.prefix = prefix
+        self.layout = layout
         os.makedirs(directory, exist_ok=True)
 
-    def path_for(self, step: int) -> str:
-        return os.path.join(self.directory, f"{self.prefix}_{step:010d}.npz")
+    def path_for(self, step: int, layout: Optional[str] = None) -> str:
+        suffix = ".ckpt" if (layout or self.layout) == "sharded" else ".npz"
+        return os.path.join(
+            self.directory, f"{self.prefix}_{step:010d}{suffix}")
+
+    def _on_disk(self, step: int) -> str:
+        """The path that actually exists for ``step`` (either layout)."""
+        for layout in ("full", "sharded"):
+            p = self.path_for(step, layout)
+            if os.path.exists(p):
+                return p
+        raise FileNotFoundError(
+            f"no checkpoint for step {step} in {self.directory}")
 
     def steps(self) -> list[int]:
-        out = []
+        out = set()
         for fn in os.listdir(self.directory):
-            if fn.startswith(self.prefix + "_") and fn.endswith(".npz"):
-                try:
-                    out.append(int(fn[len(self.prefix) + 1:-4]))
-                except ValueError:
-                    continue
+            if not fn.startswith(self.prefix + "_"):
+                continue
+            stem, ext = os.path.splitext(fn)
+            if ext not in (".npz", ".ckpt"):
+                continue
+            try:
+                out.add(int(stem[len(self.prefix) + 1:]))
+            except ValueError:
+                continue
         return sorted(out)
 
     def save(self, space: CellularSpace, step: int,
              extra: Optional[dict] = None) -> str:
         from ..parallel.multihost import master_only
 
-        path = save_checkpoint(self.path_for(step), space, step, extra)
+        if self.layout == "sharded":
+            from .sharded import save_checkpoint_sharded
+
+            path = save_checkpoint_sharded(
+                self.path_for(step), space, step, extra)
+        else:
+            path = save_checkpoint(self.path_for(step), space, step, extra)
         with master_only("checkpoint-prune") as master:
             if master and self.keep > 0:  # one pruner per cluster
+                import shutil
+
                 for old in self.steps()[:-self.keep]:
-                    os.unlink(self.path_for(old))
+                    p = self._on_disk(old)
+                    shutil.rmtree(p) if os.path.isdir(p) else os.unlink(p)
         return path
 
-    def latest(self) -> Optional[Checkpoint]:
+    def latest(self, *, mesh=None, spec=None) -> Optional[Checkpoint]:
         steps = self.steps()
-        return self.restore(steps[-1]) if steps else None
+        if not steps:
+            return None
+        return self.restore(steps[-1], mesh=mesh, spec=spec)
 
-    def restore(self, step: int) -> Checkpoint:
-        return load_checkpoint(self.path_for(step))
+    def restore(self, step: int, *, mesh=None, spec=None) -> Checkpoint:
+        path = self._on_disk(step)
+        if os.path.isdir(path):
+            from .sharded import load_checkpoint_sharded
+
+            return load_checkpoint_sharded(path, mesh=mesh, spec=spec)
+        return load_checkpoint(path)
 
 
 def run_checkpointed(model, space: CellularSpace, manager: CheckpointManager,

@@ -273,7 +273,11 @@ class Model:
             # believe a kernel ran that never did)
             eligible = (bool(rates) and base_ok
                         and any(r != 0.0 for r in rates.values()))
-            field_eligible = all_pointwise and base_ok
+            # the general field kernel is for models that NEED it (some
+            # non-Diffusion pointwise flow → rates is None); an
+            # all-Diffusion model with zero rates has no transport and
+            # must not run (or be labeled) a no-op kernel
+            field_eligible = all_pointwise and base_ok and rates is None
             if impl == "pallas" and not (eligible or field_eligible):
                 raise ValueError(
                     "impl='pallas' requires all field flows to be "

@@ -205,15 +205,20 @@ class ShardMapExecutor:
         if base_ok:
             rates = model.pallas_rates()
             # empty/all-zero rates = no field transport: nothing for the
-            # kernel to do — don't claim "pallas" ran (see make_step)
-            if rates and any(r != 0.0 for r in rates.values()):
-                return ("diffusion", rates)
-            field_flows = tuple(f for f in model.flows
-                                if not isinstance(f, PointFlow))
-            if field_flows and all(
-                    getattr(f, "footprint", "unknown") == "pointwise"
-                    for f in field_flows):
-                return ("field", field_flows)
+            # kernel to do — don't claim "pallas" ran (see make_step).
+            # The general field kernel applies only when some flow NEEDS
+            # it (rates is None — a non-Diffusion pointwise flow), never
+            # as a no-op fallback for zero-rate Diffusions.
+            if rates is not None:
+                if rates and any(r != 0.0 for r in rates.values()):
+                    return ("diffusion", rates)
+            else:
+                field_flows = tuple(f for f in model.flows
+                                    if not isinstance(f, PointFlow))
+                if field_flows and all(
+                        getattr(f, "footprint", "unknown") == "pointwise"
+                        for f in field_flows):
+                    return ("field", field_flows)
         if self.step_impl == "pallas":
             raise ValueError(
                 "step_impl='pallas' requires all field flows to be "

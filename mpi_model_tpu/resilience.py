@@ -195,7 +195,11 @@ def supervised_run(
     start = 0
     initial: Optional[dict[str, float]] = None
     if manager is not None:
-        ck = manager.latest()
+        # resume onto the executor's mesh when it has one: a sharded
+        # (per-process) checkpoint then restores O(shard) via
+        # make_array_from_callback instead of dense-assembling the full
+        # grid on every host (dense .npz checkpoints ignore the mesh)
+        ck = manager.latest(mesh=getattr(executor, "mesh", None))
         if ck is not None:
             if ck.step > total:
                 raise ValueError(

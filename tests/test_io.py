@@ -140,3 +140,125 @@ def test_output_after_model_run_conserves(tmp_path):
         for line in f:
             total += float(line.split("\t")[2])
     assert total == pytest.approx(400.0, abs=1e-9)
+
+
+# -- sharded (per-process, O(shard)) checkpoint layout -----------------------
+
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from mpi_model_tpu.io import (  # noqa: E402
+    is_sharded_checkpoint,
+    load_checkpoint_sharded,
+    save_checkpoint_sharded,
+)
+from mpi_model_tpu.parallel.mesh import make_mesh_2d, shard_space  # noqa: E402
+
+
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.float32, jnp.bfloat16])
+def test_sharded_roundtrip_unsharded_space(tmp_path, dtype):
+    """Single-device arrays are one piece; roundtrip is bit-exact."""
+    space = random_space(11, 13, dtype=dtype, attrs=("a", "b"))
+    path = save_checkpoint_sharded(str(tmp_path / "ck.ckpt"), space, step=4,
+                                   extra={"k": 1})
+    assert is_sharded_checkpoint(path)
+    ck = load_checkpoint_sharded(path)
+    assert ck.step == 4 and ck.extra == {"k": 1}
+    for k in ("a", "b"):
+        got, want = np.asarray(ck.space.values[k]), np.asarray(space.values[k])
+        assert got.dtype == want.dtype
+        np.testing.assert_array_equal(got.view(np.uint8),
+                                      want.view(np.uint8))
+
+
+def test_sharded_roundtrip_mesh_sharded_space(tmp_path, eight_devices):
+    """A 2x4-mesh-sharded space checkpoints per shard (8 pieces, deduped
+    replicas) and restores both dense and re-sharded."""
+    mesh = make_mesh_2d(devices=eight_devices)
+    space = shard_space(random_space(16, 32), mesh)
+    path = save_checkpoint_sharded(str(tmp_path / "ck.ckpt"), space, step=2)
+
+    want = np.asarray(space.values["value"])
+    dense = load_checkpoint_sharded(path)
+    np.testing.assert_array_equal(np.asarray(dense.space.values["value"]),
+                                  want)
+
+    resharded = load_checkpoint_sharded(path, mesh=mesh)
+    arr = resharded.space.values["value"]
+    assert arr.sharding == NamedSharding(mesh, P("x", "y"))
+    np.testing.assert_array_equal(np.asarray(arr), want)
+
+
+def test_sharded_replicated_axis_dedups_pieces(tmp_path, eight_devices):
+    """P('x', None) replicates across the y axis: replica_id dedup must
+    write each row block once, and restore with a different spec works."""
+    mesh = make_mesh_2d(devices=eight_devices)
+    space = shard_space(random_space(8, 8), mesh, spec=P("x", None))
+    path = save_checkpoint_sharded(str(tmp_path / "ck.ckpt"), space)
+    import json
+
+    with np.load(os.path.join(path, "shards_p00000.npz")) as z:
+        pieces = json.loads(bytes(z["meta"]).decode())["pieces"]
+    assert len(pieces) == 2  # 2 row blocks, not 8 device shards
+    full = load_checkpoint_sharded(path, mesh=mesh, spec=P("x", "y"))
+    np.testing.assert_array_equal(np.asarray(full.space.values["value"]),
+                                  np.asarray(space.values["value"]))
+
+
+def test_sharded_missing_manifest_is_incomplete(tmp_path):
+    d = tmp_path / "partial.ckpt"
+    d.mkdir()
+    (d / "shards_p00000.npz").write_bytes(b"junk")
+    with pytest.raises(FileNotFoundError, match="manifest"):
+        load_checkpoint_sharded(str(d))
+
+
+def test_sharded_coverage_gap_is_an_error(tmp_path, eight_devices):
+    """A piece table that does not tile the grid must raise, not return
+    uninitialized memory."""
+    import json
+
+    mesh = make_mesh_2d(devices=eight_devices)
+    space = shard_space(random_space(8, 8), mesh)
+    path = save_checkpoint_sharded(str(tmp_path / "ck.ckpt"), space)
+    fn = os.path.join(path, "shards_p00000.npz")
+    with np.load(fn) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        payload = {k: z[k] for k in z.files if k != "meta"}
+    dropped = meta["pieces"].pop()  # lose one shard
+    payload.pop(dropped["key"])
+    payload["meta"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez(fn, **payload)
+    with pytest.raises(ValueError, match="does not cover"):
+        load_checkpoint_sharded(str(path))
+
+
+def test_manager_sharded_layout_resume_and_prune(tmp_path):
+    """run_checkpointed over the sharded layout: resume-equivalence and
+    directory pruning."""
+    space = random_space(16, 16)
+    model = Model(Diffusion(0.1), 10.0, 1.0)
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=2,
+                            layout="sharded")
+
+    out6, step6, _ = run_checkpointed(model, space, mgr, steps=6, every=2)
+    assert step6 == 6
+    assert mgr.steps() == [4, 6]  # pruned directories
+    assert is_sharded_checkpoint(mgr.path_for(6))
+
+    out10, step10, _ = run_checkpointed(model, space, mgr, steps=10, every=2)
+    assert step10 == 10
+    want, _ = model.execute(space, steps=10)
+    np.testing.assert_array_equal(np.asarray(out10.values["value"]),
+                                  np.asarray(want.values["value"]))
+
+
+def test_manager_restore_autodetects_layout(tmp_path):
+    """A manager can resume from a checkpoint written in the other layout."""
+    space = random_space(6, 6)
+    dense_mgr = CheckpointManager(str(tmp_path / "ck"), layout="full")
+    dense_mgr.save(space, step=3)
+    sharded_mgr = CheckpointManager(str(tmp_path / "ck"), layout="sharded")
+    ck = sharded_mgr.latest()
+    assert ck.step == 3
+    np.testing.assert_array_equal(np.asarray(ck.space.values["value"]),
+                                  np.asarray(space.values["value"]))

@@ -26,11 +26,15 @@ def test_gather_global_single_process():
 @pytest.mark.slow
 def test_two_process_cpu_dryrun():
     """Spawns two linked processes; the sharded step runs over a mesh
-    spanning both, a point flow crosses the process boundary, and the
-    master reports conservation."""
+    spanning both, a point flow crosses the process boundary, the master
+    reports conservation, the per-shard checkpoint round-trips with NO
+    full-grid gather, and the fused-Pallas deep-halo step (the config-5
+    stack) matches XLA across the process boundary."""
     line = multihost.dryrun_two_process(port=29791)
     assert "MASTER ok: procs=2" in line
     assert "conservation_err=0.000e+00" in line
+    assert "sharded_ckpt=ok" in line
+    assert "pallas_deep_halo=ok" in line
 
 
 def test_broadcast_str_rejects_overlong():
