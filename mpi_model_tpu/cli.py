@@ -62,7 +62,17 @@ def _build_executor(args):
 
     from .parallel import ShardMapExecutor, make_mesh, make_mesh_2d
 
-    lines, columns = (int(v) for v in args.mesh.lower().split("x"))
+    try:
+        parts = [int(v) for v in args.mesh.lower().split("x")]
+        if len(parts) == 1:  # "--mesh=N" = 1-D row stripes (Model.hpp:62-76)
+            parts.append(1)
+        lines, columns = parts
+        if lines < 1 or columns < 1:
+            raise ValueError
+    except ValueError:
+        raise SystemExit(
+            f"--mesh={args.mesh!r} is not N or LxC with positive extents "
+            "(e.g. --mesh=4, --mesh=2x4)")
     n = lines * columns
     devices = jax.devices()
     if len(devices) < n:

@@ -157,12 +157,20 @@ class CheckpointManager:
             f"no checkpoint for step {step} in {self.directory}")
 
     def steps(self) -> list[int]:
+        from .sharded import is_sharded_checkpoint
+
         out = set()
         for fn in os.listdir(self.directory):
             if not fn.startswith(self.prefix + "_"):
                 continue
             stem, ext = os.path.splitext(fn)
             if ext not in (".npz", ".ckpt"):
+                continue
+            if ext == ".ckpt" and not is_sharded_checkpoint(
+                    os.path.join(self.directory, fn)):
+                # manifest-less = crashed mid-save: not a checkpoint (the
+                # commit record is the manifest) — resume must fall back
+                # to the previous COMPLETE one, not die on this husk
                 continue
             try:
                 out.add(int(stem[len(self.prefix) + 1:]))
@@ -185,9 +193,22 @@ class CheckpointManager:
             if master and self.keep > 0:  # one pruner per cluster
                 import shutil
 
+                from .sharded import is_sharded_checkpoint
+
                 for old in self.steps()[:-self.keep]:
                     p = self._on_disk(old)
                     shutil.rmtree(p) if os.path.isdir(p) else os.unlink(p)
+                # incomplete (manifest-less) sharded dirs are crash husks
+                # invisible to steps(); clear them now that a newer
+                # checkpoint is durable
+                for fn in os.listdir(self.directory):
+                    p = os.path.join(self.directory, fn)
+                    if (fn.startswith(self.prefix + "_")
+                            and fn.endswith(".ckpt") and os.path.isdir(p)
+                            and not is_sharded_checkpoint(p)
+                            and os.path.abspath(p)
+                            != os.path.abspath(path)):
+                        shutil.rmtree(p, ignore_errors=True)
         return path
 
     def latest(self, *, mesh=None, spec=None) -> Optional[Checkpoint]:

@@ -87,10 +87,11 @@ class Executor(Protocol):
 
 
 class SerialExecutor:
-    """Single-device execution: ``jit(scan(step))`` (the reference's serial
+    """Single-device execution: a jitted step loop (the reference's serial
     ``execute()`` stub, ``Model.hpp:47-51``, 'missing implement' — here
-    implemented). The jitted runner is cached per (step, num_steps) so
-    repeated ``execute`` calls don't retrace.
+    implemented). The jitted runner is cached per step pair; trip counts
+    are TRACED scalars, so repeated ``execute`` calls never retrace —
+    whatever the step count.
 
     ``step_impl`` selects the per-step kernel: ``"xla"`` (fused stencil
     ops), ``"pallas"`` (the fused TPU kernel — Diffusion-only field flows),
@@ -122,23 +123,24 @@ class SerialExecutor:
         step_any = stepk or step1
         # num_steps=0 builds no step at all — nothing ran, report None
         self.last_impl = step_any.impl if step_any is not None else None
-        key = (stepk, step1, q, r)
+        # the trip counts are TRACED scalars, so the cache key is only
+        # which steps exist: chunked/supervised runs of any size reuse
+        # one compile (at most 3 variants: k-only, 1-only, k+1)
+        key = (stepk, step1)
         runner = self._cache.get(key)
         if runner is None:
-            def _run(v):
-                def scan_of(fn, c, length):
-                    def body(carry, _):
-                        return fn(carry), None
-                    out, _ = jax.lax.scan(body, c, None, length=length)
-                    return out
-                if q:
-                    v = scan_of(stepk, v, q)
-                if r:
-                    v = scan_of(step1, v, r)
+            def _run(v, nq, nr):
+                def loop(fn, c, count):
+                    return jax.lax.fori_loop(
+                        0, count, lambda i, carry: fn(carry), c)
+                if stepk is not None:
+                    v = loop(stepk, v, nq)
+                if step1 is not None:
+                    v = loop(step1, v, nr)
                 return v
             runner = jax.jit(_run)
             self._cache[key] = runner
-        return runner(dict(space.values))
+        return runner(dict(space.values), jnp.int32(q), jnp.int32(r))
 
 
 class Model:

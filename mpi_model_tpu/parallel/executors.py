@@ -20,11 +20,16 @@ paths); the conservation contract holds because shares crossing shard
 boundaries are delivered via halos, and true grid edges see ppermute's
 zero-fill (non-periodic boundary).
 
-Point flows are carried as dense one-hot fields sharded like the grid —
-the owner test (``Model.hpp:176,189``) becomes data placement instead of a
-rank branch, so a source sitting on a shard's last row (the reference's
-deliberate default: cell (19,3) on rank 1's stripe edge, ``Main.cpp:33``)
-needs no special case: its neighbor-share rides the ordinary halo.
+Point flows are SPARSE per-shard scatters (the serial path's
+``point_flow_step`` economics): the owner test (``Model.hpp:176,189``)
+becomes a mask instead of a rank branch, so a source sitting on a shard's
+last row (the reference's deliberate default: cell (19,3) on rank 1's
+stripe edge, ``Main.cpp:33``) needs no special case — its neighbor-share
+rides the ordinary halo.
+
+Every runner takes the step count as a TRACED scalar (dynamic trip
+count), so supervisor chunks of any size — including the remainder chunk
+— and step-count sweeps reuse one compilation per model/space geometry.
 """
 
 from __future__ import annotations
@@ -74,26 +79,24 @@ class AutoShardedExecutor:
     def run_model(self, model, space: CellularSpace, num_steps: int) -> Values:
         _check_divisible(space, self.mesh)
         step = model.make_step(space)
-        key = (step, num_steps)
-        runner = self._cache.get(key)
+        runner = self._cache.get(step)
         if runner is None:
             sharding = NamedSharding(self.mesh, self.spec)
 
-            def _run(v):
-                def body(c, _):
+            def _run(v, n):
+                def body(i, c):
                     out = step(c)
                     # keep the carry pinned to the mesh layout across steps
-                    out = {k: jax.lax.with_sharding_constraint(a, sharding)
-                           for k, a in out.items()}
-                    return out, None
-                out, _ = jax.lax.scan(body, v, None, length=num_steps)
-                return out
+                    return {k: jax.lax.with_sharding_constraint(a, sharding)
+                            for k, a in out.items()}
+                # n is a TRACED scalar: one compile serves any step count
+                return jax.lax.fori_loop(0, n, body, v)
 
             runner = jax.jit(_run)
-            self._cache[key] = runner
+            self._cache[step] = runner
         values = {k: put_global(v, NamedSharding(self.mesh, self.spec))
                   for k, v in space.values.items()}
-        return runner(values)
+        return runner(values, jnp.int32(num_steps))
 
 
 class ShardMapExecutor:
@@ -155,35 +158,6 @@ class ShardMapExecutor:
     def comm_size(self) -> int:
         return int(np.prod(list(self.mesh.shape.values())))
 
-    # -- constant-field construction --------------------------------------
-
-    def _point_flow_fields(self, model, space: CellularSpace
-                           ) -> tuple[Values, Values]:
-        """(const_outflow, dyn_rate): dense one-hot global fields for the
-        model's point flows, keyed by attribute. Frozen-snapshot flows
-        contribute a constant outflow; dynamic ones a rate field multiplied
-        by the current value each step."""
-        shape, dtype = space.shape, space.dtype
-        const_of: dict[str, np.ndarray] = {}
-        dyn_rate: dict[str, np.ndarray] = {}
-        for f in model.flows:
-            if not isinstance(f, PointFlow):
-                continue
-            x, y = f.source_xy
-            lx, ly = x - space.x_init, y - space.y_init
-            if not (0 <= lx < space.dim_x and 0 <= ly < space.dim_y):
-                continue
-            if f.frozen_source_value is not None:
-                tgt = const_of.setdefault(f.attr, np.zeros(shape, np.float64))
-                tgt[lx, ly] += f.flow_rate * f.frozen_source_value
-            else:
-                tgt = dyn_rate.setdefault(f.attr, np.zeros(shape, np.float64))
-                tgt[lx, ly] += f.flow_rate
-        to_dev = {}
-        for d, src in (("const", const_of), ("dyn", dyn_rate)):
-            to_dev[d] = {k: jnp.asarray(v, dtype=dtype) for k, v in src.items()}
-        return to_dev["const"], to_dev["dyn"]
-
     # -- execution ---------------------------------------------------------
 
     def _pallas_plan(self, model, space: CellularSpace):
@@ -235,15 +209,19 @@ class ShardMapExecutor:
         _check_divisible(space, self.mesh)
         # origin is part of the identity: the compiled runners bake
         # row0/col0 and the boundary mask from it, so two same-shaped
-        # partitions at different origins must not share a runner
+        # partitions at different origins must not share a runner. The
+        # STEP COUNT is deliberately NOT part of it: runners take the
+        # count as a traced scalar (dynamic trip count), so a supervisor
+        # sweeping chunk sizes or a remainder chunk reuses one compile.
         key = (space.shape, space.global_shape,
                (space.x_init, space.y_init), str(space.dtype),
-               tuple(space.values), model.offsets, num_steps,
+               tuple(space.values), model.offsets,
                tuple(f.fingerprint() for f in model.flows))
         spec = grid_spec(self.mesh)
         sharding = NamedSharding(self.mesh, spec)
         put = partial(put_global, sharding=sharding)
         values = {k: put(v) for k, v in space.values.items()}
+        n = jnp.int32(num_steps)
 
         from ..utils.tracing import get_tracer
 
@@ -261,10 +239,8 @@ class ShardMapExecutor:
                     self.last_impl = "pallas"
                     return out
                 with get_tracer().span("shardmap.build", impl="deep-halo",
-                                       steps=num_steps,
                                        depth=self.halo_depth):
-                    runner = self._build_deep_runner(model, space,
-                                                     num_steps)
+                    runner = self._build_deep_runner(model, space)
                 entry = ("xla", runner)
                 self._cache[key] = entry
             kind, runner = entry
@@ -272,7 +248,7 @@ class ShardMapExecutor:
             #: fallback) — the CLI/bench report it so a user never
             #: believes they measured a configuration that never ran
             self.last_impl = kind
-            return runner(values)
+            return runner(values, n)
 
         entry = self._cache.get(key)
         if entry is None:
@@ -283,19 +259,12 @@ class ShardMapExecutor:
                 self._cache[key] = ("pallas", prunner)
                 self.last_impl = "pallas"
                 return out
-            with get_tracer().span("shardmap.build", impl="xla",
-                                   steps=num_steps):
-                entry = ("xla", self._build_runner(model, space, num_steps))
+            with get_tracer().span("shardmap.build", impl="xla"):
+                entry = ("xla", self._build_runner(model, space))
             self._cache[key] = entry
         kind, runner = entry
         self.last_impl = kind
-        if kind == "pallas":
-            return runner(values)
-
-        const_of, dyn_rate = self._point_flow_fields(model, space)
-        const_of = {k: put(v) for k, v in const_of.items()}
-        dyn_rate = {k: put(v) for k, v in dyn_rate.items()}
-        return runner(values, const_of, dyn_rate)
+        return runner(values, n)
 
     def _probe_pallas(self, model, space, num_steps, values, *, label,
                       fallback_name):
@@ -315,11 +284,11 @@ class ShardMapExecutor:
         tracer = get_tracer()
         try:
             with tracer.span("shardmap.build", impl=label,
-                             steps=num_steps, depth=self.halo_depth):
-                prunner = self._build_pallas_runner(
-                    model, space, num_steps, plan)
+                             depth=self.halo_depth):
+                prunner = self._build_pallas_runner(model, space, plan)
             with tracer.span("shardmap.compile+first_run", impl=label):
-                out = jax.block_until_ready(prunner(values))
+                out = jax.block_until_ready(
+                    prunner(values, jnp.int32(num_steps)))
         except Exception as e:
             if self.step_impl == "pallas":
                 raise
@@ -329,8 +298,7 @@ class ShardMapExecutor:
             return None, None
         return prunner, out
 
-    def _build_deep_runner(self, model, space: CellularSpace,
-                           num_steps: int):
+    def _build_deep_runner(self, model, space: CellularSpace):
         """Deep-halo execution: one depth-d ghost exchange per d local
         steps, for ANY pointwise field flows (Diffusion, Coupled, user
         flows). All channels are padded; each step evaluates every flow's
@@ -399,7 +367,7 @@ class ShardMapExecutor:
                 return pad_with_halo_2d(z, names[0], names[1], nx, ny,
                                         depth=d)
 
-        def shard_fn(values):
+        def shard_fn(values, n):
             row0 = np.int32(x_init) + lax.axis_index(names[0]) * np.int32(
                 local_h)
             col0 = (np.int32(y_init)
@@ -501,22 +469,23 @@ class ShardMapExecutor:
             chunk = (chunk_uniform if uniform_rates is not None
                      else chunk_general)
 
-            q, r = divmod(num_steps, D)
-            out = values
-            if q:
-                def body(carry, _):
-                    return chunk(carry, D), None
-                out, _ = lax.scan(body, out, None, length=q)
-            if r:
-                out = chunk(out, r)
+            # n is a TRACED scalar (dynamic trip count): one compile
+            # serves every step count. q full-depth chunks, then a
+            # lax.switch over the D possible remainder depths.
+            q = n // D
+            out = lax.fori_loop(0, q, lambda i, c: chunk(c, D), values)
+            if D > 1:
+                branches = [lambda c: c] + [
+                    (lambda d: lambda c: chunk(c, d))(d)
+                    for d in range(1, D)]
+                out = lax.switch(n - q * D, branches, out)
             return out
 
-        sharded = jax.shard_map(shard_fn, mesh=mesh, in_specs=(spec,),
+        sharded = jax.shard_map(shard_fn, mesh=mesh, in_specs=(spec, P()),
                                 out_specs=spec)
         return jax.jit(sharded)
 
-    def _build_pallas_runner(self, model, space: CellularSpace,
-                             num_steps: int, plan: tuple):
+    def _build_pallas_runner(self, model, space: CellularSpace, plan: tuple):
         """Per-shard fused Pallas kernel fed by the ppermute ghost ring —
         the config-5 architecture (SURVEY §7 'Pallas at 16384²'): the
         fast kernel and the distributed runtime in one compiled step.
@@ -561,7 +530,7 @@ class ShardMapExecutor:
             return (zero_ring(z, ns) if self.halo_mode == "zero"
                     else exchange_ring(z, ax, nx, ay, ny, depth=ns))
 
-        def shard_fn(values):
+        def shard_fn(values, n):
             row0 = lax.axis_index(ax) * np.int32(local_h)
             col0 = (lax.axis_index(ay) * np.int32(local_w) if ay
                     else jnp.int32(0))
@@ -589,23 +558,25 @@ class ShardMapExecutor:
                         c, rings, origin, gshape, payload, offsets,
                         interpret=interpret, nsteps=ns)
 
-            q, r = divmod(num_steps, depth)
-            out = values
-            if q:
-                def body(carry, _):
-                    return chunk(carry, depth), None
-                out, _ = lax.scan(body, out, None, length=q)
-            if r:
-                out = chunk(out, r)
+            # dynamic trip count (n traced): q full-depth fused chunks,
+            # then a switch over the possible remainder depths — each
+            # branch instantiates the kernel at its own (static) nsteps
+            q = n // depth
+            out = lax.fori_loop(0, q, lambda i, c: chunk(c, depth), values)
+            if depth > 1:
+                branches = [lambda c: c] + [
+                    (lambda d: lambda c: chunk(c, d))(d)
+                    for d in range(1, depth)]
+                out = lax.switch(n - q * depth, branches, out)
             return out
 
         # check_vma=False: pallas_call's out_shape carries no
         # varying-mesh-axes metadata, which the checker would demand
-        sharded = jax.shard_map(shard_fn, mesh=mesh, in_specs=(spec,),
+        sharded = jax.shard_map(shard_fn, mesh=mesh, in_specs=(spec, P()),
                                 out_specs=spec, check_vma=False)
         return jax.jit(sharded)
 
-    def _build_runner(self, model, space: CellularSpace, num_steps: int):
+    def _build_runner(self, model, space: CellularSpace):
         mesh = self.mesh
         names = mesh.axis_names
         axis_sizes = [mesh.shape[n] for n in names]
@@ -652,8 +623,40 @@ class ShardMapExecutor:
         x_init, y_init = space.x_init, space.y_init
         dtype = space.dtype
 
-        def local_step(values, counts, const_of, dyn_rate, origin):
+        point_flows = [f for f in model.flows if isinstance(f, PointFlow)]
+
+        def point_outflows(outflows, values, row0, col0):
+            """SPARSE per-shard point-flow outflows: one O(1) scatter per
+            flow into the shard owning the source (everyone else's masked
+            amount is 0), replacing the former dense one-hot rate fields
+            — no O(grid) extra operand, no per-step field multiply (the
+            serial path's ``point_flow_step`` economics, sharded). The
+            owner test (``Model.hpp:176``) is the ``inside`` mask;
+            cross-shard delivery still rides the ordinary share halo."""
+            for f in point_flows:
+                x, y = f.source_xy  # static global coords
+                lx = jnp.int32(x) - row0
+                ly = jnp.int32(y) - col0
+                inside = ((lx >= 0) & (lx < local_h)
+                          & (ly >= 0) & (ly < local_w))
+                lxc = jnp.clip(lx, 0, local_h - 1)
+                lyc = jnp.clip(ly, 0, local_w - 1)
+                if f.frozen_source_value is not None:
+                    amt = jnp.asarray(f.flow_rate * f.frozen_source_value,
+                                      dtype=dtype)
+                else:
+                    amt = jnp.asarray(f.flow_rate, dtype=dtype) \
+                        * values[f.attr][lxc, lyc]
+                amt = jnp.where(inside, amt, jnp.zeros((), dtype))
+                base = outflows.get(f.attr)
+                if base is None:
+                    base = jnp.zeros((local_h, local_w), dtype)
+                outflows[f.attr] = base.at[lxc, lyc].add(amt)
+            return outflows
+
+        def local_step(values, counts, row0, col0):
             new = dict(values)
+            origin = (row0, col0)
             padded_vals = (
                 {k: pad(v) for k, v in values.items()} if any_ring1 else None)
             outflows: dict[str, jax.Array] = {}
@@ -665,36 +668,31 @@ class ShardMapExecutor:
                     # serial path passes the space's origin the same way
                     o = f.outflow(values, origin)
                 outflows[f.attr] = outflows.get(f.attr, 0.0) + o
-            for attr, c in const_of.items():
-                outflows[attr] = outflows.get(attr, 0.0) + c
-            for attr, r in dyn_rate.items():
-                outflows[attr] = outflows.get(attr, 0.0) + r * values[attr]
+            outflows = point_outflows(outflows, values, row0, col0)
             for attr, outflow in outflows.items():
                 share = outflow / counts
                 inflow = gather_from_padded(pad(share), offsets)
                 new[attr] = values[attr] - outflow + inflow
             return new
 
-        def shard_fn(values, const_of, dyn_rate):
+        def shard_fn(values, n):
             from jax import lax
 
             from ..ops.stencil import neighbor_counts_traced
             row0 = np.int32(x_init) + lax.axis_index(names[0]) * np.int32(local_h)
             col0 = (np.int32(y_init) + lax.axis_index(names[1]) * np.int32(local_w)
                     if len(names) > 1 else jnp.int32(y_init))
-            origin = (row0, col0)
             # per-shard counts as traced iota arithmetic — no O(grid)
             # host array, no extra sharded operand (mirrors make_step)
             counts = neighbor_counts_traced((local_h, local_w), offsets,
-                                            origin, gshape, dtype)
+                                            (row0, col0), gshape, dtype)
 
-            def body(c, _):
-                return local_step(c, counts, const_of, dyn_rate, origin), None
-            out, _ = jax.lax.scan(body, values, None, length=num_steps)
-            return out
+            # n is a TRACED scalar: every step count runs one compile
+            return lax.fori_loop(
+                0, n, lambda i, c: local_step(c, counts, row0, col0), values)
 
         sharded = jax.shard_map(
             shard_fn, mesh=mesh,
-            in_specs=(spec, spec, spec),
+            in_specs=(spec, P()),
             out_specs=spec)
         return jax.jit(sharded)

@@ -262,3 +262,20 @@ def test_manager_restore_autodetects_layout(tmp_path):
     assert ck.step == 3
     np.testing.assert_array_equal(np.asarray(ck.space.values["value"]),
                                   np.asarray(space.values["value"]))
+
+
+def test_incomplete_sharded_checkpoint_falls_back(tmp_path):
+    """A crash mid-save leaves a manifest-less .ckpt dir; latest() must
+    resume from the previous COMPLETE checkpoint, and the next save
+    clears the husk."""
+    space = random_space(6, 6)
+    mgr = CheckpointManager(str(tmp_path / "ck"), layout="sharded")
+    mgr.save(space, step=2)
+    husk = tmp_path / "ck" / "ckpt_0000000004.ckpt"
+    husk.mkdir()
+    (husk / "shards_p00000.npz").write_bytes(b"junk")
+    assert mgr.steps() == [2]
+    ck = mgr.latest()
+    assert ck is not None and ck.step == 2
+    mgr.save(space, step=6)
+    assert not husk.exists()  # prune removed the crash husk

@@ -623,3 +623,57 @@ def test_shardmap_pallas_field_kernel_modulator_untouched(mesh1d):
                                             check_conservation=False)
     assert ex.last_impl == "pallas"
     np.testing.assert_array_equal(got.to_numpy()["b"], b0)
+
+
+def test_one_compile_across_step_counts(eight_devices):
+    """Runners take the step count as a traced scalar: a supervisor
+    sweeping chunk sizes (including a remainder chunk) must reuse ONE
+    shard_map build/compile (round-3 VERDICT weak #5)."""
+    from mpi_model_tpu.utils import Tracer, set_tracer
+
+    mesh = make_mesh(4, devices=eight_devices[:4])
+    space = CellularSpace.create(16, 12, 1.0, dtype="float64")
+    model = Model([Diffusion(0.2), PointFlow(source=(7, 5), flow_rate=0.5)],
+                  10.0, 1.0)
+    ex = ShardMapExecutor(mesh)
+    tr = Tracer()
+    prev = set_tracer(tr)
+    try:
+        for steps in (4, 7, 4, 1, 0):
+            out = ex.run_model(model, space, steps)
+            want, _ = model.execute(space, steps=steps)
+            np.testing.assert_allclose(
+                np.asarray(out["value"]),
+                np.asarray(want.values["value"]), atol=1e-12)
+        builds = [s for s in tr.spans if s.name == "shardmap.build"]
+        assert len(builds) == 1, [s.meta for s in builds]
+    finally:
+        set_tracer(prev)
+
+
+def test_one_compile_across_step_counts_deep_pallas(eight_devices):
+    """Dynamic trip count composes with deep halos and the fused Pallas
+    kernel: remainder depths go through a switch, not a recompile."""
+    from mpi_model_tpu.utils import Tracer, set_tracer
+
+    mesh = make_mesh(4, devices=eight_devices[:4])
+    space = CellularSpace.create(16, 16, 1.0, dtype="float32")
+    vals = {"value": jnp.asarray(
+        np.random.default_rng(3).uniform(0.5, 2.0, (16, 16)), jnp.float32)}
+    space = space.with_values(vals)
+    model = Model(Diffusion(0.2), 10.0, 1.0)
+    ex = ShardMapExecutor(mesh, step_impl="pallas", halo_depth=2)
+    tr = Tracer()
+    prev = set_tracer(tr)
+    try:
+        for steps in (4, 5, 2, 3):
+            out = ex.run_model(model, space, steps)
+            assert ex.last_impl == "pallas"
+            want, _ = model.execute(space, steps=steps)
+            np.testing.assert_allclose(
+                np.asarray(out["value"]),
+                np.asarray(want.values["value"]), atol=1e-5)
+        builds = [s for s in tr.spans if s.name == "shardmap.build"]
+        assert len(builds) == 1, [s.meta for s in builds]
+    finally:
+        set_tracer(prev)
