@@ -1,0 +1,273 @@
+"""Point-subsystem fast path: step only the cells a point flow touches.
+
+The reference's live workload is ONE point flow on a 100×100 grid
+(``/root/reference/src/Main.cpp:32-33``): per step, exactly the source
+cell and its ≤8 Moore neighbors change (``Model.hpp:176-235``) — yet a
+naive compiled loop carries the whole O(grid) array through every
+µs-scale step, which is why tiny configs lost to a single-core NumPy
+loop (round-3 VERDICT weak #3). This module extracts the *involved
+subsystem* — the static union of sources and their in-partition
+neighbors, m ≤ 9·k cells — steps an ``[m+1]``-vector in the compiled
+loop (the ``+1`` is a dummy slot absorbing dropped shares), and scatters
+the result back into the grid ONCE per run.
+
+Faithfulness: the common case — every touched cell receives exactly one
+contribution per step (any number of non-overlapping frozen flows; the
+reference's exact workload) — collapses each step to one ``[m+1]``
+vector add whose entries are the full path's own per-step values, so
+results are BITWISE identical to the full-grid path
+(``ops.stencil.point_flow_step``). The sequenced branches (overlapping
+neighborhoods, dynamic amounts) perform the same logical operations but
+XLA may reassociate the small-vector chains differently than the
+full-grid scatters: they match to ≤1 ULP per step — the same fidelity
+class as the deep-halo general path (``executors._build_deep_runner``),
+and well inside the conservation contract. Golden tests pin both tiers.
+
+Eligibility (``build_point_plans`` returns None otherwise):
+- every flow is a ``PointFlow`` (any field flow touches O(grid) cells);
+- float dtype;
+- sharded use additionally requires every flow frozen (a dynamic
+  amount reads the owner shard's source value, which other shards do
+  not hold — and with frozen amounts NO halo exchange is needed at
+  all: each shard updates its owned involved cells locally).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.cellular_space import CellularSpace
+from .flow import PointFlow
+
+#: fall back to the full-grid path beyond this many point flows: the
+#: subsystem stops being "tiny" and the full path's vectorized scatters
+#: amortize better
+MAX_FLOWS = 64
+
+
+@dataclasses.dataclass
+class PointPlan:
+    """Per-attribute involved-cell subsystem (all coords LOCAL to the
+    space's array; validity/topology already folded in at build time)."""
+
+    attr: str
+    #: [m] involved-cell local coords (unique, deterministic order)
+    xs: np.ndarray
+    ys: np.ndarray
+    #: all-frozen single-add fast path: sub += delta per step ([m+1])
+    delta: Optional[np.ndarray]
+    #: all-frozen, per-phase-distinct targets: sequence of [m+1] adds
+    phase_deltas: Optional[list[np.ndarray]]
+    #: general (dynamic flows): vectorized per-flow spec
+    dyn: Optional[dict]
+
+    @property
+    def m(self) -> int:
+        return len(self.xs)
+
+    @property
+    def frozen_only(self) -> bool:
+        return self.dyn is None
+
+
+def _neighbor_count(gx: int, gy: int, gdx: int, gdy: int, offsets) -> int:
+    return sum(1 for dx, dy in offsets
+               if 0 <= gx + dx < gdx and 0 <= gy + dy < gdy)
+
+
+def build_point_plans(flows: Sequence, space: CellularSpace,
+                      offsets: Sequence[tuple[int, int]],
+                      ) -> Optional[dict[str, PointPlan]]:
+    """Static subsystem extraction; None when the model is ineligible."""
+    if not flows or len(flows) > MAX_FLOWS:
+        return None
+    if not all(isinstance(f, PointFlow) for f in flows):
+        return None
+    dtype = np.dtype(jnp.dtype(space.dtype))
+    if not jnp.issubdtype(space.dtype, jnp.floating):
+        return None
+
+    h, w = space.dim_x, space.dim_y
+    gdx, gdy = space.global_shape
+    x0, y0 = space.x_init, space.y_init
+
+    by_attr: dict[str, list[PointFlow]] = {}
+    for f in flows:
+        lx, ly = f.source_xy[0] - x0, f.source_xy[1] - y0
+        if 0 <= lx < h and 0 <= ly < w:  # owner test (Model.hpp:176)
+            by_attr.setdefault(f.attr, []).append(f)
+
+    plans: dict[str, PointPlan] = {}
+    for attr, pflows in by_attr.items():
+        # entry table: unique local cells, sources first then neighbors,
+        # in flow×offset order (determinism = stable cache keys)
+        index: dict[tuple[int, int], int] = {}
+
+        def entry(lx: int, ly: int) -> int:
+            return index.setdefault((lx, ly), len(index))
+
+        spec = []  # per flow: (src_entry, amt_or_None, rate, count, tgts)
+        for f in pflows:
+            lx, ly = f.source_xy[0] - x0, f.source_xy[1] - y0
+            src_e = entry(lx, ly)
+            count = _neighbor_count(lx + x0, ly + y0, gdx, gdy, offsets)
+            # frozen amount with the full path's exact rounding: python
+            # f64 product, then one cast to the grid dtype
+            amt = (dtype.type(f.flow_rate * f.frozen_source_value)
+                   if f.frozen_source_value is not None else None)
+            tgts = []
+            for dx, dy in offsets:
+                nx, ny = lx + dx, ly + dy
+                # delivery is LOCAL-bounds (shares leaving the partition
+                # drop, reference-worker semantics); counts were GLOBAL
+                tgts.append(entry(nx, ny) if 0 <= nx < h and 0 <= ny < w
+                            else None)
+            spec.append((src_e, amt, f.flow_rate, count, tgts))
+
+        m = len(index)
+        xs = np.fromiter((c[0] for c in index), np.int32, m)
+        ys = np.fromiter((c[1] for c in index), np.int32, m)
+
+        all_frozen = all(s[1] is not None for s in spec)
+        delta = phase_deltas = dyn = None
+        if all_frozen:
+            # contribution list in full-path op order: one source-phase
+            # scatter, then one scatter per offset
+            phases: list[list[tuple[int, np.generic]]] = []
+            phases.append([(s[0], dtype.type(-s[1])) for s in spec])
+            for oi in range(len(offsets)):
+                ph = []
+                for src_e, amt, _rate, count, tgts in spec:
+                    if tgts[oi] is not None:
+                        ph.append((tgts[oi], dtype.type(amt
+                                                        / dtype.type(count))))
+                phases.append(ph)
+            flat = [t for ph in phases for t, _ in ph]
+            if len(set(flat)) == len(flat):
+                # every touched cell gets exactly one add per step →
+                # the whole step is ONE vector add (0.0 elsewhere)
+                delta = np.zeros(m + 1, dtype)
+                for ph in phases:
+                    for t, v in ph:
+                        delta[t] = v
+            elif all(len({t for t, _ in ph}) == len(ph) for ph in phases):
+                phase_deltas = []
+                for ph in phases:
+                    d = np.zeros(m + 1, dtype)
+                    for t, v in ph:
+                        d[t] = v
+                    phase_deltas.append(d)
+            # duplicate targets inside one phase: scatter-add combine
+            # order is the full path's business — fall through to dyn
+        if delta is None and phase_deltas is None:
+            dyn = dict(
+                src=np.asarray([s[0] for s in spec], np.int32),
+                frozen=np.asarray([s[1] is not None for s in spec]),
+                const_amt=np.asarray(
+                    [s[1] if s[1] is not None else 0 for s in spec], dtype),
+                rate=np.asarray([s[2] for s in spec], dtype),
+                count=np.asarray([s[3] for s in spec], dtype),
+                # [n_offsets, k]: entry index, dummy m when dropped
+                tgt=np.asarray([[s[4][oi] if s[4][oi] is not None else m
+                                 for s in spec]
+                                for oi in range(len(offsets))], np.int32),
+                valid=np.asarray([[s[4][oi] is not None for s in spec]
+                                  for oi in range(len(offsets))]),
+            )
+        plans[attr] = PointPlan(attr, xs, ys, delta, phase_deltas, dyn)
+    return plans
+
+
+def subsystem_step(plan: PointPlan, dtype):
+    """The per-step function on the ``[m+1]`` subsystem vector —
+    bitwise-parallel to ``point_flow_step`` on the full grid."""
+    if plan.delta is not None:
+        d = jnp.asarray(plan.delta)
+
+        def step(sub):
+            return sub + d
+        return step
+    if plan.phase_deltas is not None:
+        ds = [jnp.asarray(d) for d in plan.phase_deltas]
+
+        def step(sub):
+            for d in ds:
+                sub = sub + d
+            return sub
+        return step
+
+    dyn = plan.dyn
+    src = jnp.asarray(dyn["src"])
+    frozen = jnp.asarray(dyn["frozen"])
+    const_amt = jnp.asarray(dyn["const_amt"])
+    rate = jnp.asarray(dyn["rate"])
+    count = jnp.asarray(dyn["count"])
+    tgt = jnp.asarray(dyn["tgt"])
+    valid = jnp.asarray(dyn["valid"])
+    zero = jnp.zeros((), dtype)
+
+    def step(sub):
+        # amounts read the PRE-step values (summed-outflow semantics)
+        amts = jnp.where(frozen, const_amt, rate * sub[src])
+        share = amts / count
+        out = sub.at[src].add(-amts)
+        for oi in range(tgt.shape[0]):
+            out = out.at[tgt[oi]].add(jnp.where(valid[oi], share, zero))
+        return out
+    return step
+
+
+def serial_point_runner(plans: dict[str, PointPlan], dtype):
+    """(values, n) → values: gather each attr's subsystem, loop n tiny
+    steps, scatter back once. Jit-compatible; n is a traced scalar."""
+    steps = {a: subsystem_step(p, dtype) for a, p in plans.items()}
+
+    def run(values, n):
+        new = dict(values)
+        for attr, plan in plans.items():
+            xs, ys = jnp.asarray(plan.xs), jnp.asarray(plan.ys)
+            sub = jnp.concatenate([values[attr][xs, ys],
+                                   jnp.zeros((1,), dtype)])
+            step = steps[attr]
+            sub = jax.lax.fori_loop(0, n, lambda i, s, f=step: f(s), sub)
+            new[attr] = values[attr].at[xs, ys].set(sub[:plan.m])
+        return new
+    return run
+
+
+def shard_point_runner(plans: dict[str, PointPlan], dtype,
+                       local_h: int, local_w: int):
+    """Per-shard subsystem runner (all flows frozen): every shard evolves
+    the full entry table (constant deltas — no communication, ever) and
+    scatters back only the entries it owns; non-owned gathers are clipped
+    garbage that dies in the dummy pad cell. Returns
+    ``(values, shard_off_x, shard_off_y, n) → values`` for use inside
+    ``shard_map`` (offsets are ``axis_index``-derived traced scalars)."""
+    assert all(p.frozen_only for p in plans.values())
+    steps = {a: subsystem_step(p, dtype) for a, p in plans.items()}
+
+    def run(values, off_x, off_y, n):
+        new = dict(values)
+        for attr, plan in plans.items():
+            sx = jnp.asarray(plan.xs) - off_x
+            sy = jnp.asarray(plan.ys) - off_y
+            owned = ((sx >= 0) & (sx < local_h)
+                     & (sy >= 0) & (sy < local_w))
+            sxc = jnp.clip(sx, 0, local_h - 1)
+            syc = jnp.clip(sy, 0, local_w - 1)
+            sub = jnp.concatenate([values[attr][sxc, syc],
+                                   jnp.zeros((1,), dtype)])
+            step = steps[attr]
+            sub = jax.lax.fori_loop(0, n, lambda i, s, f=step: f(s), sub)
+            padded = jnp.pad(values[attr], ((0, 1), (0, 1)))
+            px = jnp.where(owned, sxc, local_h)
+            py = jnp.where(owned, syc, local_w)
+            padded = padded.at[px, py].set(sub[:plan.m])
+            new[attr] = padded[:local_h, :local_w]
+        return new
+    return run

@@ -1,0 +1,175 @@
+"""Point-subsystem fast path (ops/point_kernel.py): plan selection,
+bitwise parity with the full-grid paths (serial + sharded + GSPMD), and
+fallback behavior. The round-3 VERDICT's 'win the small end' item — the
+reference's live workload is exactly one frozen point flow
+(Main.cpp:32-33)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_model_tpu import (
+    Attribute,
+    Cell,
+    CellularSpace,
+    Diffusion,
+    Exponencial,
+    Model,
+    PointFlow,
+)
+from mpi_model_tpu.models.model import SerialExecutor
+from mpi_model_tpu.ops.point_kernel import build_point_plans
+from mpi_model_tpu.parallel import (
+    AutoShardedExecutor,
+    ShardMapExecutor,
+    make_mesh,
+    make_mesh_2d,
+)
+
+RNG = np.random.default_rng(7)
+
+
+def rspace(h, w, dtype=jnp.float64):
+    vals = {"value": jnp.asarray(RNG.uniform(0.5, 2.0, (h, w)), dtype=dtype)}
+    return CellularSpace.create(h, w, 1.0, dtype=dtype).with_values(vals)
+
+
+def test_single_frozen_flow_collapses_to_one_add():
+    space = CellularSpace.create(16, 16, 1.0, dtype="float64")
+    flows = [Exponencial(Cell(5, 5, Attribute(99, 2.2)), 0.1)]
+    plans = build_point_plans(flows, space, Model(flows).offsets)
+    p = plans["value"]
+    assert p.delta is not None and p.m == 9
+    # source sheds 0.22, each of 8 neighbors gains 0.22/8
+    assert p.delta[0] == np.float64(-(0.1 * 2.2))
+    assert np.isclose(p.delta[1:9].sum(), 0.22)
+    assert p.delta[9] == 0.0  # dummy slot
+
+
+def test_overlapping_frozen_flows_keep_exact_order():
+    """Two sources 2 apart share neighbor cells → no single-delta
+    collapse; phase/dyn path preserves full-path rounding."""
+    space = CellularSpace.create(16, 16, 1.0, dtype="float64")
+    flows = [Exponencial(Cell(5, 5, Attribute(99, 2.2)), 0.1),
+             Exponencial(Cell(5, 7, Attribute(99, 1.7)), 0.2)]
+    plans = build_point_plans(flows, space, Model(flows).offsets)
+    assert plans["value"].delta is None
+
+
+def test_overlapping_frozen_flows_match_full_grid_to_ulp(eight_devices):
+    """The sequenced (phase/dyn) branches are NOT guaranteed bitwise —
+    XLA may reassociate the small-vector chains — but must match the
+    full-grid path to ~1 ULP per step (the documented tier)."""
+    space = rspace(16, 16)
+    model = Model([Exponencial(Cell(5, 5, Attribute(99, 2.2)), 0.1),
+                   Exponencial(Cell(5, 7, Attribute(99, 1.7)), 0.2)],
+                  10.0, 1.0)
+    mini, _ = model.execute(space)
+    full, _ = model.execute(space, AutoShardedExecutor(make_mesh(4)))
+    np.testing.assert_allclose(np.asarray(mini.values["value"]),
+                               np.asarray(full.values["value"]),
+                               rtol=0, atol=1e-13)
+
+
+def test_duplicate_source_flows_match_full_grid_to_ulp(eight_devices):
+    """Two frozen flows on the SAME source cell: duplicate targets in
+    the source phase force the dyn branch; ≤1 ULP/step vs full grid."""
+    space = rspace(12, 12)
+    model = Model([Exponencial(Cell(4, 4, Attribute(99, 2.0)), 0.2),
+                   Exponencial(Cell(4, 4, Attribute(99, 1.0)), 0.15)],
+                  8.0, 1.0)
+    mini, _ = model.execute(space)
+    full, _ = model.execute(space, AutoShardedExecutor(make_mesh(4)))
+    np.testing.assert_allclose(np.asarray(mini.values["value"]),
+                               np.asarray(full.values["value"]),
+                               rtol=0, atol=1e-13)
+
+
+def test_field_flow_disqualifies():
+    space = CellularSpace.create(8, 8, 1.0, dtype="float64")
+    flows = [Diffusion(0.1), PointFlow(source=(3, 3), flow_rate=0.1)]
+    assert build_point_plans(flows, space, Model(flows).offsets) is None
+
+
+@pytest.mark.parametrize("src", [(0, 0), (0, 5), (19, 3), (9, 9)])
+def test_serial_mini_bitwise_vs_gspmd_full_grid(eight_devices, src):
+    """Corner (3 neighbors), edge (5), stripe-edge and interior sources:
+    the mini path must equal the full-grid step bitwise. GSPMD
+    (AutoShardedExecutor) still runs make_step's full-grid path — it is
+    the in-tree bitwise oracle for the mini path."""
+    space = rspace(20, 12)
+    model = Model(Exponencial(Cell(*src, Attribute(99, 2.2)), 0.1),
+                  7.0, 1.0)
+    mini, _ = model.execute(space)
+    full, _ = model.execute(space, AutoShardedExecutor(make_mesh(4)))
+    np.testing.assert_array_equal(np.asarray(mini.values["value"]),
+                                  np.asarray(full.values["value"]))
+
+
+def test_dynamic_flow_mini_bitwise_vs_full(eight_devices):
+    space = rspace(16, 16)
+    model = Model(PointFlow(source=(7, 7), flow_rate=0.15), 9.0, 1.0)
+    mini, _ = model.execute(space)
+    full, _ = model.execute(space, AutoShardedExecutor(make_mesh(4)))
+    np.testing.assert_array_equal(np.asarray(mini.values["value"]),
+                                  np.asarray(full.values["value"]))
+
+
+def test_sharded_mini_2d_mesh_cross_corner(eight_devices):
+    """Source adjacent to a 2-D block corner: shares land on 3 other
+    shards with NO halo exchange — owners add their own constants."""
+    mesh = make_mesh_2d(devices=eight_devices)  # 2x4
+    space = rspace(16, 32)
+    # block size 8x8; source at (7,7) touches blocks (0,0),(0,1),(1,0),(1,1)
+    model = Model(Exponencial(Cell(7, 7, Attribute(99, 2.2)), 0.1), 6.0, 1.0)
+    ex = ShardMapExecutor(mesh)
+    sh, _ = model.execute(space, ex)
+    assert ex.last_impl == "xla"
+    se, _ = model.execute(space)
+    np.testing.assert_array_equal(np.asarray(sh.values["value"]),
+                                  np.asarray(se.values["value"]))
+
+
+def test_sharded_dynamic_falls_back_to_halo_loop(eight_devices):
+    """A dynamic point flow is ineligible sharded (the source value
+    lives on one shard): the executor must run the halo-loop path and
+    still match serial bitwise."""
+    mesh = make_mesh(4, devices=eight_devices[:4])
+    space = rspace(16, 12)
+    model = Model(PointFlow(source=(3, 3), flow_rate=0.2), 5.0, 1.0)
+    ex = ShardMapExecutor(mesh)
+    sh, _ = model.execute(space, ex)
+    se, _ = model.execute(space)
+    np.testing.assert_array_equal(np.asarray(sh.values["value"]),
+                                  np.asarray(se.values["value"]))
+
+
+def test_partition_space_drops_cross_edge_shares():
+    """Reference-worker semantics: a standalone partition drops shares
+    leaving it (no halo receiver) — the mini path must reproduce the
+    full path's drop behavior."""
+    part = CellularSpace.create(10, 10, 1.0, dtype="float64", x_init=10,
+                                y_init=0, global_dim_x=100,
+                                global_dim_y=100)
+    # source on the partition's first row: 3 of its 8 neighbors lie in
+    # the previous partition and must be dropped
+    model = Model(Exponencial(Cell(10, 5, Attribute(99, 2.2)), 0.1),
+                  4.0, 1.0)
+    out, rep = model.execute(part, check_conservation=False)
+    v = np.asarray(out.values["value"])
+    # counts are GLOBAL topology (interior cell: 8), so each in-partition
+    # neighbor gets 0.22/8 per step; the 3 outside shares vanish
+    assert np.isclose(v[1, 5], 1.0 + 4 * 0.22 / 8)
+    # initial 100 cells of 1.0; each step sheds 0.22, of which 5 shares
+    # of 0.22/8 stay in-partition (3 drop off the first row)
+    assert np.isclose(float(v.sum()),
+                      100.0 - 4 * 0.22 + 4 * 5 * 0.22 / 8)
+
+
+def test_mini_num_steps_zero_is_identity():
+    space = rspace(8, 8)
+    model = Model(Exponencial(Cell(3, 3, Attribute(99, 2.2)), 0.1), 1.0, 1.0)
+    ex = SerialExecutor()
+    out = ex.run_model(model, space, 0)
+    np.testing.assert_array_equal(np.asarray(out["value"]),
+                                  np.asarray(space.values["value"]))
