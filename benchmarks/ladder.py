@@ -112,6 +112,35 @@ def tpu_serial_cups(grid: int, dtype_name: str, flows, impl: str = "auto",
             "substeps": substeps}
 
 
+
+def _bench_mesh_and_space(grid, mesh_shape, dtype_name, flows):
+    """Shared setup for the sharded benchmark rows: virtual CPU mesh (1-D
+    or 2-D), typed space seeded per attr, and the model."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_model_tpu import CellularSpace, Model
+    from mpi_model_tpu.parallel import make_mesh, make_mesh_2d
+
+    n = 1
+    for m in mesh_shape:
+        n *= m
+    cpus = jax.devices("cpu")
+    if len(cpus) < n:
+        raise RuntimeError(
+            f"need {n} CPU devices; launch with XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n}")
+    mesh = (make_mesh(mesh_shape[0], devices=cpus[:n])
+            if len(mesh_shape) == 1
+            else make_mesh_2d(*mesh_shape, devices=cpus[:n]))
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+             "float64": jnp.float64}[dtype_name]
+    attrs = sorted({f.attr for f in flows})
+    space = CellularSpace.create(grid, grid,
+                                 {a: 1.0 for a in attrs} or 1.0, dtype=dtype)
+    return mesh, space, Model(list(flows), 1.0, 1.0), cpus, n
+
+
 def sharded_cups_and_halo(grid: int, mesh_shape: tuple, dtype_name: str,
                           flows, step_impl: str = "xla",
                           s1: int = 5, s2: int = 25, reps: int = 2,
@@ -122,29 +151,11 @@ def sharded_cups_and_halo(grid: int, mesh_shape: tuple, dtype_name: str,
     ``halo_depth > 1`` measures the deep-halo executor (one depth-d
     exchange per d steps)."""
     import jax
-    import jax.numpy as jnp
 
-    from mpi_model_tpu import CellularSpace, Model
-    from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh, make_mesh_2d
+    from mpi_model_tpu.parallel import ShardMapExecutor
 
-    n = 1
-    for m in mesh_shape:
-        n *= m
-    cpus = jax.devices("cpu")
-    if len(cpus) < n:
-        raise RuntimeError(
-            f"need {n} CPU devices; launch with XLA_FLAGS="
-            f"--xla_force_host_platform_device_count={n}")
-    if len(mesh_shape) == 1:
-        mesh = make_mesh(mesh_shape[0], devices=cpus[:n])
-    else:
-        mesh = make_mesh_2d(*mesh_shape, devices=cpus[:n])
-
-    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
-             "float64": jnp.float64}[dtype_name]
-    attrs = sorted({f.attr for f in flows})
-    space = CellularSpace.create(grid, grid,
-                                 {a: 1.0 for a in attrs} or 1.0, dtype=dtype)
+    mesh, space, model, cpus, n = _bench_mesh_and_space(
+        grid, mesh_shape, dtype_name, flows)
 
     with jax.default_device(cpus[0]):
         times = {}
@@ -152,7 +163,6 @@ def sharded_cups_and_halo(grid: int, mesh_shape: tuple, dtype_name: str,
                      else ("exchange",)):
             ex = ShardMapExecutor(mesh, step_impl=step_impl, halo_mode=mode,
                                   halo_depth=halo_depth)
-            model = Model(list(flows), 1.0, 1.0)
 
             def run(steps: int):
                 out = ex.run_model(model, space, steps)
@@ -168,6 +178,29 @@ def sharded_cups_and_halo(grid: int, mesh_shape: tuple, dtype_name: str,
         halo_share = None  # not measured, or timing noise on tiny grids
     return {"cups": grid * grid / t if t > 0 else None,
             "step_ms": t * 1e3, "halo_share": halo_share, "devices": n}
+
+
+def gspmd_cups(grid: int, mesh_shape: tuple, dtype_name: str, flows,
+               s1: int = 10, s2: int = 60, reps: int = 3) -> dict:
+    """The GSPMD path (AutoShardedExecutor: global step + sharding
+    annotations, XLA inserts the halos) on the same virtual mesh — the
+    evidence row for keeping both executors (round-3 VERDICT weak #6)."""
+    import jax
+
+    from mpi_model_tpu.parallel import AutoShardedExecutor
+
+    mesh, space, model, cpus, n = _bench_mesh_and_space(
+        grid, mesh_shape, dtype_name, flows)
+    ex = AutoShardedExecutor(mesh)
+
+    with jax.default_device(cpus[0]):
+        def run(steps: int):
+            jax.block_until_ready(ex.run_model(model, space, steps))
+
+        from mpi_model_tpu.utils import marginal_runner_time
+        t = marginal_runner_time(run, s1=s1, s2=s2, reps=reps)
+    return {"cups": grid * grid / t if t > 0 else None,
+            "step_ms": t * 1e3, "devices": n}
 
 
 # -- the ladder --------------------------------------------------------------
@@ -258,6 +291,8 @@ def config3(quick: bool = False) -> dict:
                               s1=10, s2=60, reps=3)
     deep = sharded_cups_and_halo(g, (2, 4), "float32", [Diffusion(0.1)],
                                  s1=10, s2=60, reps=3, halo_depth=4)
+    gspmd = gspmd_cups(g, (2, 4), "float32", [Diffusion(0.1)],
+                       s1=10, s2=60, reps=3)
     serial = tpu_serial_cups(g, "float32", [Diffusion(0.1)],
                              s1=50, s2=550 if not quick else 250)
     return {
@@ -268,6 +303,9 @@ def config3(quick: bool = False) -> dict:
             deep["halo_share"],
         "deep_halo_speedup": (deep["cups"] / r["cups"]
                               if r["cups"] and deep["cups"] else None),
+        "gspmd_cups": gspmd["cups"],
+        "gspmd_vs_shardmap": (gspmd["cups"] / r["cups"]
+                              if r["cups"] and gspmd["cups"] else None),
         "tpu_serial_cups": serial["cups"], "tpu_impl": serial["impl"],
     }
 
@@ -295,15 +333,58 @@ def config4(quick: bool = False) -> dict:
     }
 
 
+def compute_dtype_ab(grid: int = 16384, nsteps: int = 4,
+                     reps: int = 4) -> dict:
+    """bf16-storage kernel with f32 vs bf16 INTERIOR math, interleaved
+    A/B trials (tunnel noise discipline): does trading interior
+    precision for VPU throughput pay when the fused kernel is
+    VPU-bound? (round-3 VERDICT missing #4 follow-through)"""
+    import statistics
+
+    import jax.numpy as jnp
+
+    from mpi_model_tpu.ops.pallas_stencil import pallas_dense_step
+    from mpi_model_tpu.utils import marginal_step_time
+
+    v0 = {"value": jnp.ones((grid, grid), dtype=jnp.bfloat16)}
+    times: dict[str, list] = {"f32": [], "bf16": []}
+    steps = {}
+    for name, cdt in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        def step(vals, _c=cdt):
+            return {"value": pallas_dense_step(
+                vals["value"], 0.1, nsteps=nsteps, compute_dtype=_c,
+                interpret=False)}
+        steps[name] = step
+    for _ in range(reps):  # interleaved: chip-state drift hits both arms
+        for name, step in steps.items():
+            times[name].append(marginal_step_time(step, v0, s1=5, s2=25,
+                                                  reps=1))
+    med = {k: statistics.median(v) for k, v in times.items()}
+    return {"f32_compute_step_ms": med["f32"] * 1e3 / nsteps,
+            "bf16_compute_step_ms": med["bf16"] * 1e3 / nsteps,
+            "bf16_compute_speedup": (med["f32"] / med["bf16"]
+                                     if med["bf16"] > 0 else None)}
+
+
 def config5(quick: bool = False) -> dict:
     """16384^2 Moore-8 fused Pallas kernel, single chip (v4-32 scaled);
-    multi-step fusion (4 steps per HBM round-trip) vs single-step."""
+    multi-step fusion (4 steps per HBM round-trip) vs single-step, the
+    bf16-interior-math A/B, and roofline placement."""
+    import jax.numpy as jnp
+
     from mpi_model_tpu import Diffusion
+    from mpi_model_tpu.utils import stencil_roofline
 
     g = 128 if quick else 16384
     r1 = tpu_serial_cups(g, "bfloat16", [Diffusion(0.1)], s1=10, s2=50)
     r4 = tpu_serial_cups(g, "bfloat16", [Diffusion(0.1)], s1=10,
                          s2=50 if quick else 40, substeps=4)
+    # the amortized-traffic model is the fused kernel's; an XLA fallback
+    # round-trips HBM every substep
+    roof = stencil_roofline(g, jnp.dtype(jnp.bfloat16).itemsize,
+                            r4["step_ms"] / 1e3,
+                            substeps=4 if r4["impl"] == "pallas" else 1)
+    ab = None if quick else compute_dtype_ab(g)
     return {
         "config": 5, "grid": g, "flow": "diffusion",
         "strategy": "fused Pallas, single TPU chip",
@@ -311,6 +392,8 @@ def config5(quick: bool = False) -> dict:
         "step_ms": r4["step_ms"], "substeps": 4,
         "single_step_cups": r1["cups"], "multistep_speedup":
             r4["cups"] / r1["cups"] if r1["cups"] else None,
+        **roof,
+        **(ab or {}),
     }
 
 

@@ -1,9 +1,12 @@
 from .metrics import marginal_runner_time, marginal_step_time
+from .roofline import chip_peaks, stencil_roofline
 from .tracing import Span, Tracer, get_tracer, set_tracer, trace_span
 
 __all__ = [
     "marginal_step_time",
     "marginal_runner_time",
+    "chip_peaks",
+    "stencil_roofline",
     "Span",
     "Tracer",
     "get_tracer",

@@ -29,3 +29,36 @@ def test_ladder_config2_quick():
     assert row["config"] == 2
     assert "halo_share" in row
     assert row["strategy"].startswith("1-D row stripes")
+
+
+def test_roofline_fields():
+    """Roofline math: traffic amortizes over fused substeps, arithmetic
+    does not; unknown chips report measurements without invented peaks."""
+    from mpi_model_tpu.utils import stencil_roofline
+
+    r1 = stencil_roofline(1024, 4, t_step_s=1e-3, substeps=1)
+    r4 = stencil_roofline(1024, 4, t_step_s=1e-3, substeps=4)
+    assert r1["bytes_per_step"] == 2 * 1024 * 1024 * 4
+    assert r4["bytes_per_step"] == r1["bytes_per_step"] / 4
+    assert r4["flops_per_step"] == r1["flops_per_step"]
+    assert r1["achieved_gbps"] == r1["bytes_per_step"] / 1e-3 / 1e9
+    # CPU test rig: device_kind unknown → no percent-of-peak invented
+    assert r1["pct_of_hbm_peak"] is None or isinstance(
+        r1["pct_of_hbm_peak"], float)
+
+
+def test_chip_peaks_env_override(monkeypatch):
+    from mpi_model_tpu.utils import chip_peaks
+
+    monkeypatch.setenv("MMTPU_HBM_PEAK_GBPS", "500")
+    monkeypatch.setenv("MMTPU_VPU_PEAK_GOPS", "1000")
+    p = chip_peaks()
+    assert p is not None and p["hbm_gbps"] == 500.0
+    assert p["vpu_gops"] == 1000.0
+
+
+def test_ladder_config3_quick_has_gspmd_row():
+    import benchmarks.ladder as L
+
+    row = L.config3(quick=True)
+    assert "gspmd_cups" in row and "gspmd_vs_shardmap" in row
