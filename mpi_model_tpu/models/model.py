@@ -139,7 +139,10 @@ class SerialExecutor:
                     if plans is not None else False)
                 self._cache[key] = runner
             if runner:
-                self.last_impl = "xla"
+                # distinct label: "point" is the subsystem fast path (an
+                # XLA program, but a consumer — or a regression test —
+                # must be able to tell it from the full-grid XLA step)
+                self.last_impl = "point"
                 return runner(dict(space.values), jnp.int32(num_steps))
 
         # q multi-step calls + r single-step calls == num_steps steps

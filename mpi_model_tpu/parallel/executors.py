@@ -249,7 +249,9 @@ class ShardMapExecutor:
                     runner = False
                 self._cache[mkey] = runner
             if runner:
-                self.last_impl = "xla"
+                # "point" = the zero-collective subsystem path; distinct
+                # from "xla" so its liveness is assertable (dryrun/tests)
+                self.last_impl = "point"
                 return runner(values, n)
 
         if self.halo_depth > 1:

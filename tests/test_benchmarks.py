@@ -20,7 +20,7 @@ def test_ladder_config1_quick():
     row = config1(quick=True)
     assert row["config"] == 1
     assert row["oracle_cups"] > 0
-    assert row["framework_impl"] in ("xla", "pallas")
+    assert row["framework_impl"] in ("point", "xla", "pallas")
     assert row["native_threads_cups"] is None  # skipped in quick mode
 
 

@@ -58,13 +58,14 @@ def test_cli_reports_pallas_impl(capsys, eight_devices):
 
 def test_cli_reports_auto_fallback_as_xla(capsys):
     """--impl=auto with a point flow is Pallas-ineligible: the JSON must
-    say xla ran, not leave the user believing they benchmarked Pallas."""
+    name the kernel that ran ("point" — the subsystem fast path), not
+    leave the user believing they benchmarked Pallas."""
     rc = cli.main(["run", "--dimx=16", "--dimy=16", "--dtype=float64",
                    "--impl=auto", "--json"])
     out = capsys.readouterr().out
     assert rc == 0
     row = json.loads(out)
-    assert row["impl"] == "xla"
+    assert row["impl"] == "point"
     assert row["substeps"] == 1
 
 
