@@ -63,6 +63,21 @@ def main() -> None:
               "--xla_force_host_platform_device_count=8 to see the "
               "sharded run)")
 
+    # the rectangular demo the reference left COMMENTED OUT
+    # (Main.cpp:37-47): 20x60 over a 2x3 block grid, source (18,19)
+    # crossing both block axes — here it just runs
+    if len(devs) >= 6:
+        from mpi_model_tpu.models import ModelRectangular
+
+        rspace, rmodel = ModelRectangular.reference_scenario()
+        rout, rrep = rmodel.execute(
+            rspace, rmodel.default_executor(devices=devs[:6]))
+        print(f"rectangular 2x3 blocks (the reference's disabled demo): "
+              f"total={rrep.final_total['value']:.6f} "
+              f"|drift|={rrep.conservation_error():.2e}, "
+              f"owner of (18,19) = rank "
+              f"{rmodel.owner_of(18, 19, rspace)}")
+
 
 if __name__ == "__main__":
     main()

@@ -18,12 +18,15 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+from ..core.cellular_space import CellularSpace, Partition, block_partitions
 from .model import Model
 
 
 class ModelRectangular(Model):
     """2-D block-decomposition model: ``Model`` whose default executor is
-    a ``ShardMapExecutor`` over a 2-D device mesh."""
+    a ``ShardMapExecutor`` over a 2-D device mesh, and whose partition
+    geometry — owner lookup, per-block output — is the block map the
+    reference's 2-D variant left half-finished."""
 
     def __init__(self, flow, time: float = 1.0, time_step: float = 1.0, *,
                  lines: Optional[int] = None, columns: Optional[int] = None,
@@ -37,14 +40,90 @@ class ModelRectangular(Model):
         self.step_impl = step_impl
         self.halo_depth = halo_depth
 
+    # -- the reference's (commented-out) demo scenario ---------------------
+
+    @classmethod
+    def reference_scenario(cls, dtype="float64", **kw):
+        """(space, model) of the reference's disabled rectangular demo
+        (``/root/reference/src/Main.cpp:37-47`` + ``DefinesRectangular.hpp``):
+        a 20×60 grid over a 2×3 process grid (10×20 blocks), Exponencial
+        source at (18, 19) value 2.2 rate 0.1, time 10.0 step 0.2. The
+        source sits one cell off block (1, 0)'s south-east interior
+        corner, so its Moore shares cross BOTH block axes — the corner
+        halo case the reference never finished."""
+        from ..core.attribute import Attribute
+        from ..core.cell import Cell
+        from ..ops.flow import Exponencial
+
+        space = CellularSpace.create(20, 60, 1.0, dtype=dtype)
+        model = cls(
+            Exponencial(Cell(18, 19, Attribute(99, 2.2)), 0.1), 10.0, 0.2,
+            lines=2, columns=3, **kw)
+        return space, model
+
+    # -- block-partition geometry ------------------------------------------
+
+    def _grid_shape(self, devices=None) -> tuple[int, int]:
+        # the EXECUTED mesh is the source of truth once a default
+        # executor exists: a run over an explicit device subset (e.g. 6
+        # of 8 devices) must yield the same block map from owner_of /
+        # write_output that it actually sharded over
+        ex = self._default_executor
+        if devices is None and ex is not None:
+            names = ex.mesh.axis_names
+            return (ex.mesh.shape[names[0]],
+                    ex.mesh.shape[names[1]] if len(names) > 1 else 1)
+        from ..parallel.mesh import _devices, resolve_grid2d
+
+        return resolve_grid2d(self.lines, self.columns,
+                              len(_devices(devices)))
+
+    def partitions(self, space: CellularSpace,
+                   devices=None) -> list[Partition]:
+        """The lines × columns block map of ``space``
+        (``ModelRectangular.hpp:69-80``, remainder-safe)."""
+        lines, columns = self._grid_shape(devices)
+        return block_partitions(space.dim_x, space.dim_y, lines, columns)
+
+    def owner_of(self, x: int, y: int, space: CellularSpace,
+                 devices=None) -> int:
+        """Rank owning global cell (x, y) under the block decomposition.
+
+        The reference computes ``(x + y) / height + 1``
+        (``ModelRectangular.hpp:85``) — wrong for 2-D blocks (SURVEY §2
+        defects: e.g. cells (0, 59) and (18, 1) collide). The correct
+        owner is the block containing the cell."""
+        for p in self.partitions(space, devices):
+            if p.contains(x, y):
+                return p.rank
+        raise IndexError(f"({x}, {y}) outside the {space.shape} grid")
+
+    def write_output(self, directory: str, space: CellularSpace,
+                     devices=None, **kw) -> str:
+        """Per-BLOCK output dump + master merge — the output stage the
+        reference's 2-D variant left commented out
+        (``ModelRectangular.hpp:235-270``): one ``comm_rank{r}.txt`` per
+        block in rank-major order, merged like the 1-D model's."""
+        from ..io.output import write_output
+
+        return write_output(directory, space,
+                            partitions=self.partitions(space, devices),
+                            **kw)
+
+    # -- execution ---------------------------------------------------------
+
     def default_executor(self, devices: Optional[Sequence] = None):
-        """ShardMapExecutor on a lines × columns mesh (2-D block halo)."""
+        """ShardMapExecutor on a lines × columns mesh (2-D block halo).
+        The built executor becomes the model's default, so subsequent
+        ``owner_of``/``partitions``/``write_output`` follow ITS mesh —
+        even when it was built over an explicit device subset."""
         from ..parallel.executors import ShardMapExecutor
         from ..parallel.mesh import make_mesh_2d
 
         mesh = make_mesh_2d(self.lines, self.columns, devices=devices)
-        return ShardMapExecutor(mesh, step_impl=self.step_impl,
-                                halo_depth=self.halo_depth)
+        self._default_executor = ShardMapExecutor(
+            mesh, step_impl=self.step_impl, halo_depth=self.halo_depth)
+        return self._default_executor
 
     def execute(self, space, executor=None, **kw):
         if executor is None:

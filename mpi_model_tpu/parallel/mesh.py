@@ -54,6 +54,22 @@ def factor2d(n: int) -> tuple[int, int]:
     return best
 
 
+def resolve_grid2d(lines: Optional[int], columns: Optional[int],
+                   n: int) -> tuple[int, int]:
+    """The (lines, columns) a 2-D decomposition of ``n`` devices resolves
+    to: most-square factorization when both are None, ``n // given``
+    one-sided. THE single source of this defaulting — ``make_mesh_2d``
+    and ``ModelRectangular``'s partition geometry both call it, so the
+    owner/output block map can never diverge from the mesh."""
+    if lines is None and columns is None:
+        return factor2d(n)
+    if lines is None:
+        return n // columns, columns
+    if columns is None:
+        return lines, n // lines
+    return lines, columns
+
+
 def make_mesh_2d(lines: Optional[int] = None, columns: Optional[int] = None,
                  axes: tuple[str, str] = ("x", "y"),
                  devices: Optional[Sequence] = None) -> Mesh:
@@ -61,12 +77,7 @@ def make_mesh_2d(lines: Optional[int] = None, columns: Optional[int] = None,
     LINES_REC × COLUMNS_REC). Defaults to the most-square factorization of
     the available device count."""
     devs = _devices(devices)
-    if lines is None and columns is None:
-        lines, columns = factor2d(len(devs))
-    elif lines is None:
-        lines = len(devs) // columns
-    elif columns is None:
-        columns = len(devs) // lines
+    lines, columns = resolve_grid2d(lines, columns, len(devs))
     n = lines * columns
     if n == 0 or n > len(devs):
         raise ValueError(
