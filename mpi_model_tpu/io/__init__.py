@@ -6,9 +6,11 @@ from .checkpoint import (
     save_checkpoint,
 )
 from .sharded import (
+    commit_checkpoint_sharded,
     is_sharded_checkpoint,
     load_checkpoint_sharded,
     save_checkpoint_sharded,
+    stage_checkpoint_sharded,
 )
 from .output import (
     merge_dumps,
@@ -27,6 +29,8 @@ __all__ = [
     "save_checkpoint_sharded",
     "load_checkpoint_sharded",
     "is_sharded_checkpoint",
+    "stage_checkpoint_sharded",
+    "commit_checkpoint_sharded",
     "partition_dump_lines",
     "write_partition_dump",
     "merge_dumps",

@@ -133,13 +133,26 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, keep: int = 3, prefix: str = "ckpt",
-                 layout: str = "full"):
+                 layout: str = "full", async_writes: bool = False):
         if layout not in ("full", "sharded"):
             raise ValueError(f"layout must be 'full' or 'sharded': {layout!r}")
+        if async_writes and layout != "sharded":
+            raise ValueError(
+                "async_writes requires layout='sharded' (the staged "
+                "write/deferred-manifest protocol is the sharded format's)")
         self.directory = directory
         self.keep = int(keep)
         self.prefix = prefix
         self.layout = layout
+        #: overlap shard-file writes with the next compute chunk: save()
+        #: snapshots device shards to host and returns immediately; a
+        #: background thread writes the file and the COMMIT (barrier +
+        #: master manifest) happens at the next save()/flush(). The
+        #: uncommitted step is invisible to steps()/latest() until then.
+        #: Multi-process: every process must make the same save/flush
+        #: call sequence (true for supervised_run's SPMD cadence).
+        self.async_writes = bool(async_writes)
+        self._pending = None  # (thread, err_box, staged)
         os.makedirs(directory, exist_ok=True)
 
     def path_for(self, step: int, layout: Optional[str] = None) -> str:
@@ -180,7 +193,27 @@ class CheckpointManager:
 
     def save(self, space: CellularSpace, step: int,
              extra: Optional[dict] = None) -> str:
-        from ..parallel.multihost import master_only
+        if self.async_writes:
+            import threading
+
+            from .sharded import stage_checkpoint_sharded
+
+            self.flush()  # commit the previous step first
+            staged = stage_checkpoint_sharded(
+                self.path_for(step), space, step, extra)
+            err_box: list = []
+
+            def _write():
+                try:
+                    staged.write()
+                except BaseException as e:  # surfaced at the next drain
+                    err_box.append(e)
+
+            t = threading.Thread(target=_write, daemon=True,
+                                 name=f"ckpt-write-{step}")
+            t.start()
+            self._pending = (t, err_box, staged)
+            return staged.path
 
         if self.layout == "sharded":
             from .sharded import save_checkpoint_sharded
@@ -189,6 +222,39 @@ class CheckpointManager:
                 self.path_for(step), space, step, extra)
         else:
             path = save_checkpoint(self.path_for(step), space, step, extra)
+        self._prune(keep_path=path)
+        return path
+
+    def flush(self) -> None:
+        """Commit any pending async save: join the writer thread, barrier,
+        publish the manifest, prune. No-op when nothing is pending. Call
+        at end of run (``supervised_run`` does) or before reading
+        ``latest()`` when the newest step must be visible."""
+        if self._pending is None:
+            return
+        t, err_box, staged = self._pending
+        self._pending = None
+        t.join()
+        from .sharded import _writes_agreed, commit_checkpoint_sharded
+
+        # collective vote BEFORE the commit barrier: if any process's
+        # write failed, every process raises here together — nobody is
+        # stranded in sync waiting for a peer that already raised
+        if not _writes_agreed(not err_box):
+            # the step is simply not committed (its dir stays a
+            # manifest-less husk the next prune sweeps); resume falls
+            # back to the previous durable checkpoint
+            if err_box:
+                raise err_box[0]
+            raise RuntimeError(
+                "a peer process failed to write its checkpoint shard; "
+                "step not committed")
+        commit_checkpoint_sharded(staged)
+        self._prune(keep_path=staged.path)
+
+    def _prune(self, keep_path: str) -> None:
+        from ..parallel.multihost import master_only
+
         with master_only("checkpoint-prune") as master:
             if master and self.keep > 0:  # one pruner per cluster
                 import shutil
@@ -200,16 +266,18 @@ class CheckpointManager:
                     shutil.rmtree(p) if os.path.isdir(p) else os.unlink(p)
                 # incomplete (manifest-less) sharded dirs are crash husks
                 # invisible to steps(); clear them now that a newer
-                # checkpoint is durable
+                # checkpoint is durable. Prune never overlaps a pending
+                # async write: flush() clears _pending (and joins the
+                # writer) before calling here, and save() prunes only on
+                # the synchronous path.
                 for fn in os.listdir(self.directory):
                     p = os.path.join(self.directory, fn)
                     if (fn.startswith(self.prefix + "_")
                             and fn.endswith(".ckpt") and os.path.isdir(p)
                             and not is_sharded_checkpoint(p)
                             and os.path.abspath(p)
-                            != os.path.abspath(path)):
+                            != os.path.abspath(keep_path)):
                         shutil.rmtree(p, ignore_errors=True)
-        return path
 
     def latest(self, *, mesh=None, spec=None) -> Optional[Checkpoint]:
         steps = self.steps()

@@ -26,6 +26,7 @@ with ``run_checkpointed`` / ``resilience.supervised_run``.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import tempfile
@@ -59,18 +60,31 @@ def _shard_file(proc: int) -> str:
     return f"shards_p{proc:05d}.npz"
 
 
-def save_checkpoint_sharded(path: str, space: CellularSpace, step: int = 0,
-                            extra: Optional[dict] = None) -> str:
-    """Write ``space`` as a sharded checkpoint directory at ``path``.
+@dataclasses.dataclass
+class StagedShardSave:
+    """A per-process shard save with the DEVICE→HOST copy done but the
+    file not yet written: ``write()`` (any thread) makes this process's
+    shard file durable; ``commit_checkpoint_sharded`` (main thread, all
+    processes) then publishes the manifest. Splitting save this way is
+    what makes async checkpointing possible — the write overlaps the
+    next compute chunk, and the manifest stays a true commit record."""
 
-    Every process writes exactly one file containing its replica-0
-    addressable shards — no cross-host traffic, no full-grid gather
-    (contrast ``save_checkpoint``, which funnels O(grid) bytes to every
-    host). Process 0 writes the manifest after a barrier proves all
-    shard files are durable. Assumes (like the dense format's restore)
-    a filesystem every process sees.
-    """
-    from ..parallel.multihost import master_only, process_count, process_index, sync
+    path: str
+    manifest: dict
+    _payload: dict
+    _proc: int
+
+    def write(self) -> None:
+        _atomic_write(os.path.join(self.path, _shard_file(self._proc)),
+                      lambda f: np.savez(f, **self._payload))
+
+
+def stage_checkpoint_sharded(path: str, space: CellularSpace, step: int = 0,
+                             extra: Optional[dict] = None) -> StagedShardSave:
+    """Phase 1 of a sharded save: retract any stale manifest (collective)
+    and snapshot this process's replica-0 shards to host memory. No file
+    I/O on the grid data yet."""
+    from ..parallel.multihost import master_only, process_count, process_index
 
     proc = process_index()
     nprocs = process_count()
@@ -105,12 +119,6 @@ def save_checkpoint_sharded(path: str, space: CellularSpace, step: int = 0,
             payload[key] = data.reshape(-1).view(np.uint8)
     payload["meta"] = np.frombuffer(
         json.dumps({"pieces": pieces}).encode("utf-8"), dtype=np.uint8)
-    _atomic_write(os.path.join(path, _shard_file(proc)),
-                  lambda f: np.savez(f, **payload))
-
-    # all shard files durable before the manifest declares the checkpoint
-    # complete (manifest presence is the commit record)
-    sync("sharded-ckpt-shards")
     manifest = {
         "format": SHARDED_FORMAT_VERSION,
         "layout": "sharded",
@@ -126,12 +134,62 @@ def save_checkpoint_sharded(path: str, space: CellularSpace, step: int = 0,
         "process_count": nprocs,
         "files": [_shard_file(p) for p in range(nprocs)],
     }
+    return StagedShardSave(path=path, manifest=manifest, _payload=payload,
+                           _proc=proc)
+
+
+def commit_checkpoint_sharded(staged: StagedShardSave) -> str:
+    """Phase 2 (main thread, every process, AFTER ``staged.write()``
+    returned): barrier proving all shard files durable, then the master
+    publishes the manifest — the commit record."""
+    from ..parallel.multihost import master_only, sync
+
+    sync("sharded-ckpt-shards")
     with master_only("sharded-ckpt-manifest") as master:
         if master:
             _atomic_write(
-                os.path.join(path, MANIFEST),
-                lambda f: f.write(json.dumps(manifest, indent=1).encode()))
-    return path
+                os.path.join(staged.path, MANIFEST),
+                lambda f: f.write(
+                    json.dumps(staged.manifest, indent=1).encode()))
+    return staged.path
+
+
+def save_checkpoint_sharded(path: str, space: CellularSpace, step: int = 0,
+                            extra: Optional[dict] = None) -> str:
+    """Write ``space`` as a sharded checkpoint directory at ``path``.
+
+    Every process writes exactly one file containing its replica-0
+    addressable shards — no cross-host traffic, no full-grid gather
+    (contrast ``save_checkpoint``, which funnels O(grid) bytes to every
+    host). Process 0 writes the manifest after a barrier proves all
+    shard files are durable. Assumes (like the dense format's restore)
+    a filesystem every process sees. (= stage → write → commit in one
+    synchronous call; ``CheckpointManager(async_writes=True)`` overlaps
+    the write with compute instead.)
+    """
+    staged = stage_checkpoint_sharded(path, space, step, extra)
+    err: Optional[BaseException] = None
+    try:
+        staged.write()
+    except BaseException as e:  # vote first — a bare raise strands peers
+        err = e
+    if not _writes_agreed(err is None):
+        if err is not None:
+            raise err
+        raise RuntimeError(
+            "a peer process failed to write its checkpoint shard; "
+            f"step {step} was not committed")
+    return commit_checkpoint_sharded(staged)
+
+
+def _writes_agreed(ok: bool) -> bool:
+    """Collective vote that every process's shard write succeeded — the
+    commit barrier must only be entered when ALL can commit (one process
+    raising while the rest sit in ``sync`` would strand them until the
+    cluster heartbeat kills the job)."""
+    from ..parallel.multihost import all_agree
+
+    return all_agree(ok)
 
 
 class _ShardFileReader:

@@ -96,6 +96,22 @@ def gather_global(x) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
+def all_agree(flag: bool) -> bool:
+    """True iff EVERY process passes True — a collective vote (allgather
+    + min; single-process: identity). Use before a cluster-wide commit
+    whose per-process preparation can fail: raising on one process while
+    the others enter a barrier strands them until the heartbeat kills
+    the job, whereas a vote lets every process raise (or commit)
+    together."""
+    import jax
+    if jax.process_count() == 1:
+        return bool(flag)
+    from jax.experimental import multihost_utils
+    out = multihost_utils.process_allgather(
+        np.asarray([1 if flag else 0], np.int32))
+    return bool(np.asarray(out).min())
+
+
 def sync(name: str = "barrier") -> None:
     """Cross-process barrier (no-op single-process)."""
     import jax

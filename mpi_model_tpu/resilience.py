@@ -226,6 +226,32 @@ def supervised_run(
 
     tracer = get_tracer()
     events: list[FailureEvent] = []
+    try:
+        return _supervise_loop(
+            model, space, manager, total, every, max_failures, executor,
+            health_checks, threshold, initial, good_space, good_step,
+            tracer, events, on_event)
+    finally:
+        if manager is not None:
+            # async managers: the last good step's write may still be in
+            # flight — commit it EVEN when the run is raising, or a
+            # verified-good checkpoint dies staged (the exact scenario
+            # checkpoints exist for). A flush failure must not mask the
+            # run's own exception.
+            import sys as _sys
+
+            try:
+                getattr(manager, "flush", lambda: None)()
+            except BaseException:
+                if _sys.exc_info()[0] is None:
+                    raise
+                tracer.instant("supervise.flush_failed")
+
+
+def _supervise_loop(model, space, manager, total, every, max_failures,
+                    executor, health_checks, threshold, initial,
+                    good_space, good_step, tracer, events, on_event
+                    ) -> SupervisedResult:
     consecutive = 0
     report: Optional[Report] = None
     while good_step < total:

@@ -279,3 +279,127 @@ def test_incomplete_sharded_checkpoint_falls_back(tmp_path):
     assert ck is not None and ck.step == 2
     mgr.save(space, step=6)
     assert not husk.exists()  # prune removed the crash husk
+
+
+# -- async (deferred-commit) sharded writes ----------------------------------
+
+def test_async_save_defers_commit_until_flush(tmp_path):
+    """save() returns with the step invisible (manifest pending); the
+    next save commits the previous step; flush commits the last."""
+    space = random_space(8, 8)
+    mgr = CheckpointManager(str(tmp_path / "ck"), layout="sharded",
+                            async_writes=True)
+    mgr.save(space, step=2)
+    assert 2 not in mgr.steps()  # staged, not yet committed
+    mgr.save(space, step=4)      # commits step 2
+    assert mgr.steps() == [2]
+    mgr.flush()
+    assert mgr.steps() == [2, 4]
+    mgr.flush()  # idempotent
+    ck = mgr.latest()
+    assert ck.step == 4
+    np.testing.assert_array_equal(np.asarray(ck.space.values["value"]),
+                                  np.asarray(space.values["value"]))
+
+
+def test_async_snapshot_isolated_from_later_mutation(tmp_path):
+    """The staged save snapshots host bytes at save() time: data written
+    later must be the values AS OF the save, not the array object's
+    latest contents."""
+    space = random_space(8, 8)
+    mgr = CheckpointManager(str(tmp_path / "ck"), layout="sharded",
+                            async_writes=True)
+    want = np.asarray(space.values["value"]).copy()
+    mgr.save(space, step=1)
+    # a NEW space (functional update) must not affect the staged bytes
+    space2 = space.with_values(
+        {"value": space.values["value"] * 2.0})
+    del space2
+    mgr.flush()
+    got = np.asarray(mgr.latest().space.values["value"])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_async_requires_sharded_layout(tmp_path):
+    with pytest.raises(ValueError, match="sharded"):
+        CheckpointManager(str(tmp_path), async_writes=True)
+
+
+def test_async_write_failure_surfaces_and_falls_back(tmp_path, monkeypatch):
+    """A failed background write raises at the next flush, and the step
+    stays a husk — latest() falls back to the previous commit."""
+    import mpi_model_tpu.io.sharded as sh
+
+    space = random_space(6, 6)
+    mgr = CheckpointManager(str(tmp_path / "ck"), layout="sharded",
+                            async_writes=True)
+    mgr.save(space, step=1)
+    mgr.flush()
+    orig = sh.StagedShardSave.write
+
+    def boom(self):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(sh.StagedShardSave, "write", boom)
+    mgr.save(space, step=2)
+    with pytest.raises(OSError, match="disk full"):
+        mgr.flush()
+    monkeypatch.setattr(sh.StagedShardSave, "write", orig)
+    assert mgr.steps() == [1]
+    assert mgr.latest().step == 1
+    mgr.save(space, step=3)  # recovery: next save sweeps the husk
+    mgr.flush()
+    assert mgr.steps() == [1, 3]
+
+
+def test_supervised_run_with_async_manager(tmp_path):
+    """supervised_run over an async manager: final state durable (flush
+    at end), resume-equivalence preserved."""
+    from mpi_model_tpu.resilience import supervised_run
+
+    space = random_space(16, 16)
+    model = Model(Diffusion(0.1), 10.0, 1.0)
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=3,
+                            layout="sharded", async_writes=True)
+    res = supervised_run(model, space, mgr, steps=6, every=2)
+    assert res.step == 6
+    assert mgr.steps()[-1] == 6  # flushed
+
+    mgr2 = CheckpointManager(str(tmp_path / "ck"), keep=3,
+                             layout="sharded", async_writes=True)
+    res2 = supervised_run(model, space, mgr2, steps=10, every=2)
+    want, _ = model.execute(space, steps=10)
+    np.testing.assert_array_equal(np.asarray(res2.space.values["value"]),
+                                  np.asarray(want.values["value"]))
+
+
+def test_async_manager_flushes_on_run_failure(tmp_path):
+    """A SimulationFailure must not strand the last good step staged:
+    the supervisor flushes in finally, so the best verified state is
+    durable for the restart."""
+    from mpi_model_tpu.models.model import SerialExecutor
+    from mpi_model_tpu.resilience import SimulationFailure, supervised_run
+
+    class DiesAtStep4:
+        comm_size = 1
+
+        def __init__(self):
+            self.inner = SerialExecutor()
+            self.done = 0
+
+        def run_model(self, m, s, k):
+            if self.done >= 2:  # chunks 0-2, 2-4 succeed; 4-6 dies
+                raise RuntimeError("chip gone")
+            self.done += 1
+            return self.inner.run_model(m, s, k)
+
+    space = random_space(8, 8)
+    model = Model(Diffusion(0.1), 6.0, 1.0)
+    mgr = CheckpointManager(str(tmp_path / "ck"), layout="sharded",
+                            async_writes=True)
+    with pytest.raises(SimulationFailure):
+        supervised_run(model, space, mgr, steps=6, every=2,
+                       max_failures=1, executor=DiesAtStep4())
+    # step 4 (the last good chunk) was staged when the failure hit;
+    # the finally-flush must have committed it
+    assert mgr.steps()[-1] == 4
