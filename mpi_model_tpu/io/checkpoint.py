@@ -235,20 +235,15 @@ class CheckpointManager:
         t, err_box, staged = self._pending
         self._pending = None
         t.join()
-        from .sharded import _writes_agreed, commit_checkpoint_sharded
+        from .sharded import commit_checkpoint_sharded, vote_writes_or_raise
 
         # collective vote BEFORE the commit barrier: if any process's
         # write failed, every process raises here together — nobody is
-        # stranded in sync waiting for a peer that already raised
-        if not _writes_agreed(not err_box):
-            # the step is simply not committed (its dir stays a
-            # manifest-less husk the next prune sweeps); resume falls
-            # back to the previous durable checkpoint
-            if err_box:
-                raise err_box[0]
-            raise RuntimeError(
-                "a peer process failed to write its checkpoint shard; "
-                "step not committed")
+        # stranded in sync waiting for a peer that already raised. The
+        # failed step is simply not committed (its dir stays a
+        # manifest-less husk the next prune sweeps); resume falls back
+        # to the previous durable checkpoint.
+        vote_writes_or_raise(err_box[0] if err_box else None)
         commit_checkpoint_sharded(staged)
         self._prune(keep_path=staged.path)
 

@@ -173,23 +173,25 @@ def save_checkpoint_sharded(path: str, space: CellularSpace, step: int = 0,
         staged.write()
     except BaseException as e:  # vote first — a bare raise strands peers
         err = e
-    if not _writes_agreed(err is None):
-        if err is not None:
-            raise err
-        raise RuntimeError(
-            "a peer process failed to write its checkpoint shard; "
-            f"step {step} was not committed")
+    vote_writes_or_raise(err)
     return commit_checkpoint_sharded(staged)
 
 
-def _writes_agreed(ok: bool) -> bool:
-    """Collective vote that every process's shard write succeeded — the
-    commit barrier must only be entered when ALL can commit (one process
-    raising while the rest sit in ``sync`` would strand them until the
-    cluster heartbeat kills the job)."""
+def vote_writes_or_raise(err: Optional[BaseException]) -> None:
+    """Collective vote that every process's shard write succeeded; on
+    any failure EVERY process raises here together (the local error
+    where there is one). The commit barrier must only be entered when
+    ALL can commit — one process raising while the rest sit in ``sync``
+    would strand them until the cluster heartbeat kills the job."""
     from ..parallel.multihost import all_agree
 
-    return all_agree(ok)
+    if all_agree(err is None):
+        return
+    if err is not None:
+        raise err
+    raise RuntimeError(
+        "a peer process failed to write its checkpoint shard; "
+        "the step was not committed")
 
 
 class _ShardFileReader:

@@ -227,6 +227,24 @@ assert orig_shards.keys() == rest_shards.keys(), "local shard layout differs"
 for idx in orig_shards:
     np.testing.assert_array_equal(orig_shards[idx], rest_shards[idx])
 
+# ASYNC sharded checkpoints across the process boundary: staged writes,
+# deferred commit at the next save/flush, commit-by-vote — the staged
+# step must be invisible cluster-wide until committed
+from mpi_model_tpu.io import CheckpointManager
+amgr = CheckpointManager(_os.path.join({ckpt_dir!r}, "amgr"),
+                         layout="sharded", async_writes=True)
+amgr.save(out, step=3)
+assert amgr.steps() == [], amgr.steps()   # staged, uncommitted
+amgr.save(out, step=6)                    # commits 3
+assert amgr.steps() == [3], amgr.steps()
+amgr.flush()
+assert amgr.steps() == [3, 6], amgr.steps()
+ack = amgr.latest(mesh=mesh)
+def _shards_match(a, b):
+    for idx in _by_index(a):
+        np.testing.assert_array_equal(_by_index(a)[idx], _by_index(b)[idx])
+_shards_match(out.values["value"], ack.space.values["value"])
+
 # the full config-5 software stack across the process boundary: fused
 # Pallas shard step (interpret resolved from the CPU mesh) + depth-2 deep
 # halos, golden-compared against the XLA shard step over DCN
@@ -253,7 +271,8 @@ if multihost.is_master():
     print(f"MASTER ok: procs={{jax.process_count()}} "
           f"total={{float(full.sum())}} "
           f"conservation_err={{report.conservation_error():.3e}} "
-          f"ckpt=saved sharded_ckpt=ok pallas_deep_halo=ok", flush=True)
+          f"ckpt=saved sharded_ckpt=ok async_ckpt=ok "
+          f"pallas_deep_halo=ok", flush=True)
 else:
     print(f"worker {{multihost.process_index()}} done", flush=True)
 """

@@ -195,6 +195,11 @@ def supervised_run(
     start = 0
     initial: Optional[dict[str, float]] = None
     if manager is not None:
+        # an async manager may hold a STAGED save from earlier caller
+        # activity: commit it first, or latest()/steps() below read a
+        # stale resume point and our own first save would commit the
+        # unrelated step out from under this run
+        getattr(manager, "flush", lambda: None)()
         # resume onto the executor's mesh when it has one: a sharded
         # (per-process) checkpoint then restores O(shard) via
         # make_array_from_callback instead of dense-assembling the full
@@ -237,13 +242,16 @@ def supervised_run(
             # flight — commit it EVEN when the run is raising, or a
             # verified-good checkpoint dies staged (the exact scenario
             # checkpoints exist for). A flush failure must not mask the
-            # run's own exception.
+            # run's own exception — but must PROPAGATE when the run
+            # succeeded (capture the in-flight state BEFORE the inner
+            # try: inside its except, exc_info is the flush error itself)
             import sys as _sys
 
+            run_raising = _sys.exc_info()[0] is not None
             try:
                 getattr(manager, "flush", lambda: None)()
             except BaseException:
-                if _sys.exc_info()[0] is None:
+                if not run_raising:
                     raise
                 tracer.instant("supervise.flush_failed")
 

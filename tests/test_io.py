@@ -303,18 +303,18 @@ def test_async_save_defers_commit_until_flush(tmp_path):
 
 
 def test_async_snapshot_isolated_from_later_mutation(tmp_path):
-    """The staged save snapshots host bytes at save() time: data written
-    later must be the values AS OF the save, not the array object's
-    latest contents."""
+    """The staged save snapshots host bytes at save() time: a lazy
+    implementation reading ``space.values`` at background-write time
+    would capture the REBOUND channel below, not the values as of the
+    save."""
     space = random_space(8, 8)
     mgr = CheckpointManager(str(tmp_path / "ck"), layout="sharded",
                             async_writes=True)
     want = np.asarray(space.values["value"]).copy()
     mgr.save(space, step=1)
-    # a NEW space (functional update) must not affect the staged bytes
-    space2 = space.with_values(
-        {"value": space.values["value"] * 2.0})
-    del space2
+    # mutate the very dict/array the staged save could alias, BEFORE the
+    # write thread is joined
+    space.values["value"] = space.values["value"] * 2.0
     mgr.flush()
     got = np.asarray(mgr.latest().space.values["value"])
     np.testing.assert_array_equal(got, want)
@@ -403,3 +403,45 @@ def test_async_manager_flushes_on_run_failure(tmp_path):
     # step 4 (the last good chunk) was staged when the failure hit;
     # the finally-flush must have committed it
     assert mgr.steps()[-1] == 4
+
+
+def test_async_flush_failure_propagates_on_successful_run(tmp_path,
+                                                          monkeypatch):
+    """A run that SUCCEEDS but whose final staged write failed must
+    raise from the finally-flush — not silently return with the last
+    checkpoint uncommitted."""
+    import mpi_model_tpu.io.sharded as sh
+    from mpi_model_tpu.resilience import supervised_run
+
+    space = random_space(8, 8)
+    model = Model(Diffusion(0.1), 4.0, 1.0)
+    mgr = CheckpointManager(str(tmp_path / "ck"), layout="sharded",
+                            async_writes=True)
+    orig = sh.StagedShardSave.write
+
+    def fail_step4(self):
+        if self.manifest["step"] == 4:
+            raise OSError("disk full at the end")
+        orig(self)
+
+    monkeypatch.setattr(sh.StagedShardSave, "write", fail_step4)
+    with pytest.raises(OSError, match="disk full"):
+        supervised_run(model, space, mgr, steps=4, every=2)
+    assert mgr.steps()[-1] == 2  # last DURABLE step
+
+
+def test_supervised_run_flushes_preexisting_staged_save(tmp_path):
+    """A staged-but-uncommitted save from earlier caller activity must
+    be committed before resume decisions — here it surfaces loudly as
+    the stale-checkpoint ValueError instead of being committed out of
+    band mid-run."""
+    from mpi_model_tpu.resilience import supervised_run
+
+    space = random_space(8, 8)
+    model = Model(Diffusion(0.1), 4.0, 1.0)
+    mgr = CheckpointManager(str(tmp_path / "ck"), layout="sharded",
+                            async_writes=True)
+    mgr.save(space, step=10)  # staged, invisible
+    with pytest.raises(ValueError, match="step 10 > requested total 4"):
+        supervised_run(model, space, mgr, steps=4, every=2)
+    assert mgr.steps() == [10]  # committed by the entry flush, visibly

@@ -34,6 +34,7 @@ def test_two_process_cpu_dryrun():
     assert "MASTER ok: procs=2" in line
     assert "conservation_err=0.000e+00" in line
     assert "sharded_ckpt=ok" in line
+    assert "async_ckpt=ok" in line
     assert "pallas_deep_halo=ok" in line
 
 
