@@ -243,7 +243,8 @@ class CheckpointManager:
         # failed step is simply not committed (its dir stays a
         # manifest-less husk the next prune sweeps); resume falls back
         # to the previous durable checkpoint.
-        vote_writes_or_raise(err_box[0] if err_box else None)
+        vote_writes_or_raise(err_box[0] if err_box else None,
+                             staged.manifest["step"])
         commit_checkpoint_sharded(staged)
         self._prune(keep_path=staged.path)
 

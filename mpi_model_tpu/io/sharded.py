@@ -173,11 +173,12 @@ def save_checkpoint_sharded(path: str, space: CellularSpace, step: int = 0,
         staged.write()
     except BaseException as e:  # vote first — a bare raise strands peers
         err = e
-    vote_writes_or_raise(err)
+    vote_writes_or_raise(err, step)
     return commit_checkpoint_sharded(staged)
 
 
-def vote_writes_or_raise(err: Optional[BaseException]) -> None:
+def vote_writes_or_raise(err: Optional[BaseException],
+                         step: Optional[int] = None) -> None:
     """Collective vote that every process's shard write succeeded; on
     any failure EVERY process raises here together (the local error
     where there is one). The commit barrier must only be entered when
@@ -189,9 +190,10 @@ def vote_writes_or_raise(err: Optional[BaseException]) -> None:
         return
     if err is not None:
         raise err
+    which = f"step {step}" if step is not None else "the step"
     raise RuntimeError(
         "a peer process failed to write its checkpoint shard; "
-        "the step was not committed")
+        f"{which} was not committed")
 
 
 class _ShardFileReader:

@@ -231,23 +231,27 @@ def supervised_run(
 
     tracer = get_tracer()
     events: list[FailureEvent] = []
+    # explicit flag, NOT sys.exc_info(): exc_info is thread-global and
+    # also reports an exception a CALLER is currently handling, which
+    # would make a successful run called from inside an except block
+    # swallow its own flush failure
+    run_raising = False
     try:
         return _supervise_loop(
             model, space, manager, total, every, max_failures, executor,
             health_checks, threshold, initial, good_space, good_step,
             tracer, events, on_event)
+    except BaseException:
+        run_raising = True
+        raise
     finally:
         if manager is not None:
             # async managers: the last good step's write may still be in
             # flight — commit it EVEN when the run is raising, or a
             # verified-good checkpoint dies staged (the exact scenario
             # checkpoints exist for). A flush failure must not mask the
-            # run's own exception — but must PROPAGATE when the run
-            # succeeded (capture the in-flight state BEFORE the inner
-            # try: inside its except, exc_info is the flush error itself)
-            import sys as _sys
-
-            run_raising = _sys.exc_info()[0] is not None
+            # run's own exception, but must PROPAGATE when the run
+            # succeeded.
             try:
                 getattr(manager, "flush", lambda: None)()
             except BaseException:

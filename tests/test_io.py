@@ -445,3 +445,31 @@ def test_supervised_run_flushes_preexisting_staged_save(tmp_path):
     with pytest.raises(ValueError, match="step 10 > requested total 4"):
         supervised_run(model, space, mgr, steps=4, every=2)
     assert mgr.steps() == [10]  # committed by the entry flush, visibly
+
+
+def test_async_flush_failure_propagates_inside_caller_except(tmp_path,
+                                                             monkeypatch):
+    """Regression: a flush failure after a successful run must propagate
+    even when supervised_run is invoked INSIDE a caller's except block
+    (sys.exc_info() is thread-global and would have reported the
+    caller's handled exception as 'the run is raising')."""
+    import mpi_model_tpu.io.sharded as sh
+    from mpi_model_tpu.resilience import supervised_run
+
+    space = random_space(8, 8)
+    model = Model(Diffusion(0.1), 4.0, 1.0)
+    mgr = CheckpointManager(str(tmp_path / "ck"), layout="sharded",
+                            async_writes=True)
+    orig = sh.StagedShardSave.write
+
+    def fail_step4(self):
+        if self.manifest["step"] == 4:
+            raise OSError("disk full")
+        orig(self)
+
+    monkeypatch.setattr(sh.StagedShardSave, "write", fail_step4)
+    with pytest.raises(OSError, match="disk full"):
+        try:
+            raise KeyError("caller's own handled error")
+        except KeyError:
+            supervised_run(model, space, mgr, steps=4, every=2)
