@@ -119,13 +119,24 @@ def cmd_run(args) -> int:
     failure = None
     out = None
     ranks = getattr(executor, "comm_size", 1)
+    if args.async_checkpoints and args.checkpoint_layout != "sharded":
+        raise SystemExit(
+            "--async-checkpoints requires --checkpoint-layout=sharded")
+    if args.checkpoint_dir is None and (args.async_checkpoints
+                                        or args.checkpoint_layout != "full"):
+        raise SystemExit(
+            "--checkpoint-layout/--async-checkpoints configure "
+            "checkpointing; add --checkpoint-dir=DIR")
     if args.checkpoint_dir:
         from .io import CheckpointManager
         from .resilience import SimulationFailure, supervised_run
 
         try:
             res = supervised_run(
-                model, space, CheckpointManager(args.checkpoint_dir),
+                model, space,
+                CheckpointManager(args.checkpoint_dir,
+                                  layout=args.checkpoint_layout,
+                                  async_writes=args.async_checkpoints),
                 steps=steps, every=args.checkpoint_every,
                 max_failures=args.max_failures, executor=executor,
                 on_event=events.append)
@@ -259,6 +270,13 @@ def main(argv: Optional[list[str]] = None) -> int:
                      help="ghost-ring depth d: one exchange per d steps")
     run.add_argument("--checkpoint-dir", default=None)
     run.add_argument("--checkpoint-every", type=int, default=1)
+    run.add_argument("--checkpoint-layout", default="full",
+                     choices=("full", "sharded"),
+                     help="'sharded' = per-process O(shard) files, no "
+                          "full-grid gather (io/sharded.py)")
+    run.add_argument("--async-checkpoints", action="store_true",
+                     help="overlap checkpoint writes with compute "
+                          "(requires --checkpoint-layout=sharded)")
     run.add_argument("--max-failures", type=int, default=3)
     run.add_argument("--output", default=None,
                      help="write the reference-parity per-rank dump + "

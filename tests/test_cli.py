@@ -119,3 +119,46 @@ def test_inapplicable_flags_rejected(capsys):
         cli.main(["run", "--halo-depth=4"])
     with pytest.raises(SystemExit, match="substeps"):
         cli.main(["run", "--mesh=4x1", "--substeps=4"])
+
+
+def test_cli_sharded_async_checkpoints(tmp_path, capsys):
+    """The async per-shard checkpoint layout is reachable from the
+    product CLI; an interrupted step count resumes from the directory."""
+    import json as _json
+
+    from mpi_model_tpu import cli
+
+    d = str(tmp_path / "ck")
+    args = ["run", "--dimx=16", "--dimy=16", "--dtype=float64",
+            "--flow=diffusion", "--steps=6", f"--checkpoint-dir={d}",
+            "--checkpoint-every=2", "--checkpoint-layout=sharded",
+            "--async-checkpoints", "--json"]
+    assert cli.main(args) == 0
+    row = _json.loads(capsys.readouterr().out)
+    assert row["conserved"] is True
+    import os as _os
+    names = sorted(_os.listdir(d))
+    assert any(n.endswith(".ckpt") for n in names), names
+
+    # restart to a longer run resumes from the committed steps
+    args2 = [a if not a.startswith("--steps") else "--steps=10"
+             for a in args]
+    assert cli.main(args2) == 0
+    row2 = _json.loads(capsys.readouterr().out)
+    assert row2["steps"] == 10 and row2["conserved"] is True
+
+
+def test_cli_async_requires_sharded_layout(tmp_path):
+    from mpi_model_tpu import cli
+
+    with pytest.raises(SystemExit, match="sharded"):
+        cli.main(["run", "--dimx=8", "--dimy=8",
+                  f"--checkpoint-dir={tmp_path}", "--async-checkpoints"])
+
+
+def test_cli_checkpoint_flags_require_dir():
+    from mpi_model_tpu import cli
+
+    with pytest.raises(SystemExit, match="checkpoint-dir"):
+        cli.main(["run", "--dimx=8", "--dimy=8",
+                  "--checkpoint-layout=sharded"])
