@@ -88,18 +88,24 @@ def native_cups(grid: int, workers: int = 4) -> float | None:
 
 # -- framework measurements --------------------------------------------------
 
+
+def _dtype(name: str):
+    import jax.numpy as jnp
+
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+            "float64": jnp.float64}[name]
+
+
 def tpu_serial_cups(grid: int, dtype_name: str, flows, impl: str = "auto",
                     s1: int = 20, s2: int = 100, substeps: int = 1) -> dict:
     """Serial (single-chip) cell-updates/sec via Model.make_step.
     ``substeps > 1`` times the multi-step-fused kernel (substeps flow
     steps per HBM round-trip); cups still counts true cell-updates."""
-    import jax.numpy as jnp
 
     from mpi_model_tpu import CellularSpace, Model
     from mpi_model_tpu.utils import marginal_step_time
 
-    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
-             "float64": jnp.float64}[dtype_name]
+    dtype = _dtype(dtype_name)
     attrs = sorted({f.attr for f in flows})
     space = CellularSpace.create(grid, grid,
                                  {a: 1.0 for a in attrs} or 1.0, dtype=dtype)
@@ -117,7 +123,6 @@ def _bench_mesh_and_space(grid, mesh_shape, dtype_name, flows):
     """Shared setup for the sharded benchmark rows: virtual CPU mesh (1-D
     or 2-D), typed space seeded per attr, and the model."""
     import jax
-    import jax.numpy as jnp
 
     from mpi_model_tpu import CellularSpace, Model
     from mpi_model_tpu.parallel import make_mesh, make_mesh_2d
@@ -133,8 +138,7 @@ def _bench_mesh_and_space(grid, mesh_shape, dtype_name, flows):
     mesh = (make_mesh(mesh_shape[0], devices=cpus[:n])
             if len(mesh_shape) == 1
             else make_mesh_2d(*mesh_shape, devices=cpus[:n]))
-    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
-             "float64": jnp.float64}[dtype_name]
+    dtype = _dtype(dtype_name)
     attrs = sorted({f.attr for f in flows})
     space = CellularSpace.create(grid, grid,
                                  {a: 1.0 for a in attrs} or 1.0, dtype=dtype)
@@ -212,14 +216,12 @@ def serial_runner_cups(grid: int, dtype_name: str, flows,
     onto the point-subsystem fast path), marginal between two run
     lengths so fixed dispatch cancels."""
     import jax
-    import jax.numpy as jnp
 
     from mpi_model_tpu import CellularSpace, Model
     from mpi_model_tpu.models.model import SerialExecutor
     from mpi_model_tpu.utils import marginal_runner_time
 
-    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
-             "float64": jnp.float64}[dtype_name]
+    dtype = _dtype(dtype_name)
     attrs = sorted({f.attr for f in flows})
     space = CellularSpace.create(grid, grid,
                                  {a: 1.0 for a in attrs} or 1.0, dtype=dtype)
@@ -408,7 +410,11 @@ def sweep_blocks(grid: int = 8192, dtype_name: str = "bfloat16") -> list:
     from mpi_model_tpu.ops.pallas_stencil import pallas_dense_step
     from mpi_model_tpu.utils import marginal_step_time
 
-    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
+    if dtype_name not in ("float32", "bfloat16"):
+        # the Pallas kernel computes in f32: an "f64 sweep" would be
+        # mislabeled f32 math over f64 traffic, not a measurement
+        raise ValueError(f"sweep_blocks supports f32/bf16, not {dtype_name}")
+    dtype = _dtype(dtype_name)
     v0 = {"value": jnp.ones((grid, grid), dtype=dtype)}
     results = []
     for block in [(256, 512), (256, 1024), (512, 512), (512, 1024),

@@ -254,44 +254,34 @@ class ShardMapExecutor:
                 self.last_impl = "point"
                 return runner(values, n)
 
-        if self.halo_depth > 1:
-            entry = self._cache.get(key)
-            if entry is None:
-                # deep halos compose with the fused kernel: a depth-d
-                # ring feeds d fused steps per exchange (one collective
-                # round AND one HBM round-trip per d steps)
-                prunner, out = self._probe_pallas(
-                    model, space, num_steps, values, label="pallas-deep",
-                    fallback_name="the XLA deep-halo path")
-                if prunner is not None:
-                    self._cache[key] = ("pallas", prunner)
-                    self.last_impl = "pallas"
-                    return out
-                with get_tracer().span("shardmap.build", impl="deep-halo",
-                                       depth=self.halo_depth):
-                    runner = self._build_deep_runner(model, space)
-                entry = ("xla", runner)
-                self._cache[key] = entry
-            kind, runner = entry
-            #: the kernel the last run actually used (after any "auto"
-            #: fallback) — the CLI/bench report it so a user never
-            #: believes they measured a configuration that never ran
-            self.last_impl = kind
-            return runner(values, n)
-
+        # one probe/build/cache protocol for both depths: the fused
+        # Pallas kernel is tried first (deep halos compose with it — a
+        # depth-d ring feeds d fused steps per exchange: one collective
+        # round AND one HBM round-trip per d steps), else the XLA
+        # shard step (deep or pad-gather) is built
+        deep = self.halo_depth > 1
         entry = self._cache.get(key)
         if entry is None:
             prunner, out = self._probe_pallas(
-                model, space, num_steps, values, label="pallas",
-                fallback_name="the XLA pad-gather path")
+                model, space, num_steps, values,
+                label="pallas-deep" if deep else "pallas",
+                fallback_name=("the XLA deep-halo path" if deep
+                               else "the XLA pad-gather path"))
             if prunner is not None:
                 self._cache[key] = ("pallas", prunner)
                 self.last_impl = "pallas"
                 return out
-            with get_tracer().span("shardmap.build", impl="xla"):
-                entry = ("xla", self._build_runner(model, space))
+            with get_tracer().span("shardmap.build",
+                                   impl="deep-halo" if deep else "xla",
+                                   depth=self.halo_depth):
+                runner = (self._build_deep_runner(model, space) if deep
+                          else self._build_runner(model, space))
+            entry = ("xla", runner)
             self._cache[key] = entry
         kind, runner = entry
+        #: the kernel the last run actually used (after any "auto"
+        #: fallback) — the CLI/bench report it so a user never believes
+        #: they measured a configuration that never ran
         self.last_impl = kind
         return runner(values, n)
 
@@ -327,6 +317,15 @@ class ShardMapExecutor:
             return None, None
         return prunner, out
 
+    def _shard_geometry(self, space: CellularSpace):
+        """(names, nx, ny, local_h, local_w): this mesh's axis names,
+        extents, and per-shard block dims (1-D meshes: ny = 1, columns
+        un-split) — the geometry every runner builder needs."""
+        names = self.mesh.axis_names
+        nx = self.mesh.shape[names[0]]
+        ny = self.mesh.shape[names[1]] if len(names) > 1 else 1
+        return names, nx, ny, space.dim_x // nx, space.dim_y // ny
+
     def _build_point_runner(self, space: CellularSpace, plans):
         """shard_map wrapper for the frozen point-subsystem runner: each
         shard derives its window offset from ``axis_index`` and updates
@@ -336,11 +335,7 @@ class ShardMapExecutor:
         from ..ops.point_kernel import shard_point_runner
 
         mesh = self.mesh
-        names = mesh.axis_names
-        nx = mesh.shape[names[0]]
-        ny = mesh.shape[names[1]] if len(names) > 1 else 1
-        local_h = space.dim_x // nx
-        local_w = space.dim_y // ny
+        names, nx, ny, local_h, local_w = self._shard_geometry(space)
         spec = grid_spec(mesh)
         run = shard_point_runner(plans, jnp.dtype(space.dtype),
                                  local_h, local_w)
@@ -393,11 +388,7 @@ class ShardMapExecutor:
         uniform_rates = model.pallas_rates()
 
         mesh = self.mesh
-        names = mesh.axis_names
-        nx = mesh.shape[names[0]]
-        ny = mesh.shape[names[1]] if len(names) > 1 else 1
-        local_h = space.dim_x // nx
-        local_w = space.dim_y // ny
+        names, nx, ny, local_h, local_w = self._shard_geometry(space)
         # only EXCHANGED dimensions bound the depth — on a 1-D mesh the
         # columns are zero-padded, not shipped, so any width is fine
         exchanged_min = local_h if len(names) == 1 else min(local_h, local_w)
@@ -567,13 +558,9 @@ class ShardMapExecutor:
         # backend/device can disagree with where the mesh actually runs
         # (round-3 VERDICT weak #1 — both failure directions)
         interpret = mesh_interpret(mesh)
-        names = mesh.axis_names
+        names, nx, ny, local_h, local_w = self._shard_geometry(space)
         ax = names[0]
         ay = names[1] if len(names) > 1 else None
-        nx = mesh.shape[ax]
-        ny = mesh.shape[ay] if ay else 1
-        local_h = space.dim_x // nx
-        local_w = space.dim_y // ny
         gshape = (space.dim_x, space.dim_y)
         offsets = model.offsets
         spec = grid_spec(mesh)
@@ -635,8 +622,7 @@ class ShardMapExecutor:
 
     def _build_runner(self, model, space: CellularSpace):
         mesh = self.mesh
-        names = mesh.axis_names
-        axis_sizes = [mesh.shape[n] for n in names]
+        names, nx, ny, local_h, local_w = self._shard_geometry(space)
         offsets = model.offsets
         field_flows = [f for f in model.flows if not isinstance(f, PointFlow)]
         spec = grid_spec(mesh)
@@ -657,21 +643,15 @@ class ShardMapExecutor:
                 "SerialExecutor and AutoShardedExecutor.")
         any_ring1 = any(f.footprint == "ring1" for f in field_flows)
 
-        nx = axis_sizes[0]
-        ny = axis_sizes[1] if len(names) > 1 else 1
-        local_h = space.dim_x // nx
-        local_w = space.dim_y // ny
-
         if self.halo_mode == "zero":
             def pad(z):  # diagnostic: no inter-shard traffic (see __init__)
                 return jnp.pad(z, 1)
         elif len(names) == 1:
             def pad(z):
-                return pad_with_halo_1d(z, names[0], axis_sizes[0])
+                return pad_with_halo_1d(z, names[0], nx)
         else:
             def pad(z):
-                return pad_with_halo_2d(z, names[0], names[1],
-                                        axis_sizes[0], axis_sizes[1])
+                return pad_with_halo_2d(z, names[0], names[1], nx, ny)
 
         # global bounds / origin: the sharded space may itself be a
         # partition of a larger grid — boundary topology follows the TRUE
