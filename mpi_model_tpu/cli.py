@@ -215,10 +215,14 @@ def cmd_info(args) -> int:
 
     from . import __version__
 
+    from .utils import chip_peaks
+
     info = {
         "version": __version__,
         "jax_backend": jax.default_backend(),
         "devices": [f"{d.platform}:{d.id}" for d in jax.devices()],
+        "device_kind": getattr(jax.devices()[0], "device_kind", None),
+        "chip_peaks": chip_peaks(),  # None for unknown parts
         "cpu_devices": len(jax.devices("cpu")),
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),
