@@ -507,7 +507,11 @@ def test_supervised_resume_restores_onto_executor_mesh(tmp_path,
     assert arr.sharding.mesh == mesh
     # and the resumed supervised run accepts that state end-to-end —
     # PROVING the supervisor forwarded the executor's mesh and actually
-    # resumed at step 4 (not a silent from-scratch rerun)
+    # resumed at step 4 (not a silent from-scratch rerun). Reset the
+    # recordings first: the direct latest() call above must not be able
+    # to satisfy the asserts
+    Recording.latest_kwargs = None
+    Recording.resumed_step = None
     res = supervised_run(model, space, mgr2, steps=8, every=2,
                          executor=ShardMapExecutor(mesh))
     assert Recording.latest_kwargs.get("mesh") == mesh
@@ -515,3 +519,20 @@ def test_supervised_resume_restores_onto_executor_mesh(tmp_path,
     want, _ = model.execute(space, steps=8)
     np.testing.assert_array_equal(np.asarray(res.space.values["value"]),
                                   np.asarray(want.values["value"]))
+
+
+def test_sharded_restore_with_per_channel_specs(tmp_path, eight_devices):
+    """spec may be a per-channel mapping: each channel restores onto its
+    own layout (e.g. a replicated auxiliary channel beside the sharded
+    grid)."""
+    mesh = make_mesh_2d(devices=eight_devices)
+    space = shard_space(random_space(16, 32, attrs=("value", "aux")), mesh)
+    path = save_checkpoint_sharded(str(tmp_path / "ck.ckpt"), space)
+    ck = load_checkpoint_sharded(
+        path, mesh=mesh,
+        spec={"value": P("x", "y"), "aux": P()})  # aux fully replicated
+    assert ck.space.values["value"].sharding.spec == P("x", "y")
+    assert ck.space.values["aux"].sharding.spec == P()
+    for k in ("value", "aux"):
+        np.testing.assert_array_equal(np.asarray(ck.space.values[k]),
+                                      np.asarray(space.values[k]))

@@ -222,3 +222,23 @@ def test_point_plan_property_sweep(eight_devices):
             np.asarray(sh.values["value"]),
             np.asarray(full.values["value"]), rtol=0, atol=1e-12,
             err_msg=f"trial {trial} sharded: flows={flows}")
+
+
+def test_point_path_bf16_matches_full_grid(eight_devices):
+    """bf16 grids: the plan's deltas are built with numpy's ml_dtypes
+    bf16 arithmetic and must equal the device's (serial + sharded vs
+    the full-grid GSPMD path, bitwise)."""
+    space = rspace(16, 16, dtype=jnp.bfloat16)
+    model = Model(Exponencial(Cell(5, 5, Attribute(99, 2.2)), 0.1),
+                  6.0, 1.0)
+    mini, _ = model.execute(space, check_conservation=False)
+    full, _ = model.execute(space, AutoShardedExecutor(make_mesh(4)),
+                            check_conservation=False)
+    got = np.asarray(mini.values["value"])
+    want = np.asarray(full.values["value"])
+    np.testing.assert_array_equal(got.view(np.uint8), want.view(np.uint8))
+    ex = ShardMapExecutor(make_mesh(4, devices=eight_devices[:4]))
+    sh, _ = model.execute(space, ex, check_conservation=False)
+    assert ex.last_impl == "point"
+    np.testing.assert_array_equal(
+        np.asarray(sh.values["value"]).view(np.uint8), want.view(np.uint8))
