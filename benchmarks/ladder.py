@@ -312,23 +312,88 @@ def config3(quick: bool = False) -> dict:
     }
 
 
+def validate_field_kernel_on_device(flows,
+                                    tols: dict[str, float]) -> dict:
+    """Golden-gate the multi-channel field kernel on the BENCH device
+    against the composed NumPy oracle before timing it (the same
+    discipline bench.py applies to the Diffusion kernel): 1536^2 so
+    genuine interior tiles exercise the fast path alongside ring tiles.
+    Returns {dtype_name: impl the gate actually proved}; raises on an
+    oracle mismatch."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_model_tpu import CellularSpace, Model
+    from mpi_model_tpu.oracle import transport_np
+
+    rng = np.random.default_rng(17)
+    g = 1536
+    attrs = sorted({f.attr for f in flows} | {getattr(f, "modulator", f.attr)
+                                             for f in flows})
+    host = {a: rng.uniform(0.5, 2.0, (g, g)).astype(np.float64)
+            for a in attrs}
+    # composed oracle ONCE (dtype-independent): summed outflows from
+    # pre-step values, per channel
+    outflow: dict = {}
+    for f in flows:
+        o = f.flow_rate * host[f.attr] * (
+            host[f.modulator] if hasattr(f, "modulator") else 1.0)
+        outflow[f.attr] = outflow.get(f.attr, 0.0) + o
+    want = {a: (transport_np(host[a], outflow[a]) if a in outflow
+                else host[a]) for a in attrs}
+
+    impls = {}
+    for dtype_name, tol in tols.items():
+        dtype = _dtype(dtype_name)
+        space = CellularSpace.create(g, g, {a: 1.0 for a in attrs},
+                                     dtype=dtype)
+        space = space.with_values(
+            {a: jnp.asarray(host[a], dtype) for a in attrs})
+        step = Model(list(flows), 1.0, 1.0).make_step(space, impl="auto")
+        got = step(dict(space.values))
+        for a in attrs:
+            err = float(np.abs(np.asarray(got[a], np.float64)
+                               - want[a]).max())
+            if err > tol:
+                raise AssertionError(
+                    f"field-kernel on-device validation failed "
+                    f"({dtype_name}, channel {a!r}): max|err|={err:.3e} > "
+                    f"{tol:.1e} (impl={step.impl})")
+        impls[dtype_name] = step.impl
+    return impls
+
+
 def config4(quick: bool = False) -> dict:
     """8192^2 multi-attribute, coupled flows, f32 vs bf16 — the fused
-    multi-channel FIELD kernel ('auto' selects it; round 3) vs XLA."""
+    multi-channel FIELD kernel ('auto' selects it; round 3) vs XLA.
+    The kernel is oracle-gated ON THE BENCH DEVICE before timing, and a
+    timed row resolving to a kernel the gate never proved aborts
+    (bench.py's impl-mismatch discipline)."""
     from mpi_model_tpu import Coupled, Diffusion
 
     g = 64 if quick else 8192
     flows = [Diffusion(0.1, attr="a"),
              Coupled(flow_rate=0.05, attr="a", modulator="b"),
              Diffusion(0.2, attr="b")]
+    validated = (validate_field_kernel_on_device(
+        flows, {"float32": 1e-4, "bfloat16": 0.08}) if not quick else None)
     f32 = tpu_serial_cups(g, "float32", flows, s1=10, s2=50)
     bf16 = tpu_serial_cups(g, "bfloat16", flows, s1=10, s2=50)
     xla = tpu_serial_cups(g, "bfloat16", flows, impl="xla", s1=10, s2=50)
+    if validated is not None:
+        for name, row in (("float32", f32), ("bfloat16", bf16)):
+            if row["impl"] != validated[name] and row["impl"] != "xla":
+                # a fall-back TO xla is honest (the suite oracles it); a
+                # kernel the gate never checked must not be published
+                raise AssertionError(
+                    f"config4 {name} timed impl {row['impl']!r} but the "
+                    f"gate validated {validated[name]!r}")
     return {
-        "config": 4, "grid": g, "flow": "2 coupled + 2 diffusion",
+        "config": 4, "grid": g, "flow": "1 coupled + 2 diffusion",
         "strategy": "serial TPU, multi-attribute",
         "f32_cups": f32["cups"], "bf16_cups": bf16["cups"],
-        "bf16_speedup": bf16["cups"] / f32["cups"], "impl": f32["impl"],
+        "bf16_speedup": bf16["cups"] / f32["cups"],
+        "impl": f32["impl"], "bf16_impl": bf16["impl"],
         "bf16_xla_cups": xla["cups"],
         "field_kernel_speedup": (bf16["cups"] / xla["cups"]
                                  if xla["cups"] else None),
