@@ -161,11 +161,27 @@ class CheckpointManager:
             self.directory, f"{self.prefix}_{step:010d}{suffix}")
 
     def _on_disk(self, step: int) -> str:
-        """The path that actually exists for ``step`` (either layout)."""
-        for layout in ("full", "sharded"):
-            p = self.path_for(step, layout)
-            if os.path.exists(p):
-                return p
+        """The path that actually exists for ``step`` — preferring the
+        layout this manager was CONFIGURED with when both exist (a run
+        that switched layouts and re-saved the same step leaves the
+        other layout's file stale; picking it silently would restore old
+        state — round-4 ADVICE)."""
+        other = "sharded" if self.layout == "full" else "full"
+        preferred = self.path_for(step, self.layout)
+        fallback = self.path_for(step, other)
+        if os.path.exists(preferred):
+            if os.path.exists(fallback):
+                import warnings
+
+                warnings.warn(
+                    f"step {step} exists in BOTH layouts "
+                    f"({os.path.basename(preferred)} and "
+                    f"{os.path.basename(fallback)}); restoring the "
+                    f"manager's configured layout {self.layout!r} — the "
+                    f"other file may be stale", stacklevel=3)
+            return preferred
+        if os.path.exists(fallback):
+            return fallback
         raise FileNotFoundError(
             f"no checkpoint for step {step} in {self.directory}")
 
@@ -258,8 +274,15 @@ class CheckpointManager:
                 from .sharded import is_sharded_checkpoint
 
                 for old in self.steps()[:-self.keep]:
-                    p = self._on_disk(old)
-                    shutil.rmtree(p) if os.path.isdir(p) else os.unlink(p)
+                    # a layout-switch run can leave one step in BOTH
+                    # layouts; prune must clear both (removing only the
+                    # configured one would resurrect the stale other
+                    # file as that step's sole checkpoint)
+                    for layout in ("full", "sharded"):
+                        p = self.path_for(old, layout)
+                        if not os.path.exists(p):
+                            continue
+                        shutil.rmtree(p) if os.path.isdir(p) else os.unlink(p)
                 # incomplete (manifest-less) sharded dirs are crash husks
                 # invisible to steps(); clear them now that a newer
                 # checkpoint is durable. Prune never overlaps a pending

@@ -92,10 +92,19 @@ def stage_checkpoint_sharded(path: str, space: CellularSpace, step: int = 0,
 
     # re-saving into an existing checkpoint: retract the commit record
     # BEFORE touching any shard file, or a crash mid-rewrite would leave
-    # a stale manifest pointing at mixed old/new shards
+    # a stale manifest pointing at mixed old/new shards. Shard files
+    # from a previous save with a LARGER process_count would survive
+    # unreferenced forever (round-4 ADVICE) — the master clears any not
+    # in the new file list while the manifest is down.
+    new_files = {_shard_file(p) for p in range(nprocs)}
     with master_only("sharded-ckpt-retract") as master:
-        if master and os.path.exists(os.path.join(path, MANIFEST)):
-            os.unlink(os.path.join(path, MANIFEST))
+        if master:
+            if os.path.exists(os.path.join(path, MANIFEST)):
+                os.unlink(os.path.join(path, MANIFEST))
+            for fn in os.listdir(path):
+                if (fn.startswith("shards_p") and fn.endswith(".npz")
+                        and fn not in new_files):
+                    os.unlink(os.path.join(path, fn))
 
     pieces: list[dict] = []
     payload: dict[str, np.ndarray] = {}

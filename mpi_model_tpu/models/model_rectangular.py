@@ -130,4 +130,10 @@ class ModelRectangular(Model):
             if self._default_executor is None:
                 self._default_executor = self.default_executor()
             executor = self._default_executor
+        elif getattr(executor, "mesh", None) is not None:
+            # a user-built mesh executor passed explicitly becomes the
+            # geometry source of truth too: owner_of / partitions /
+            # write_output must describe the mesh that actually ran, not
+            # a re-inference from all visible devices (round-4 ADVICE)
+            self._default_executor = executor
         return super().execute(space, executor, **kw)

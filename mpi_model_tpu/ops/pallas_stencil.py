@@ -927,9 +927,15 @@ def _field_call(chans, names, flows, *, block, offsets, interpret, nsteps,
                     for c in range(C):
                         src = (chan_refs[c] if kind == "v"
                                else slab_refs[4 * c + _SLAB[kind]])
+                        # the channel index MUST be pinned to i32: a bare
+                        # Python int traces as weak i64 under
+                        # jax_enable_x64 and tpu.memref_slice rejects it
+                        # (the halo-mode silicon tests caught this —
+                        # interpret mode accepts the i64 silently)
                         cp = pltpu.make_async_copy(
                             src.at[ds(sr, nr, row_m), ds(sc, nc, col_m)],
-                            vwin.at[c, sl, pl.ds(dr, nr), pl.ds(dc, nc)],
+                            vwin.at[_i32(c), sl,
+                                    pl.ds(dr, nr), pl.ds(dc, nc)],
                             sems.at[sl, _i32(c), _i32(p)])
                         out.append((cond, cp))
             return out
@@ -946,7 +952,7 @@ def _field_call(chans, names, flows, *, block, offsets, interpret, nsteps,
                 @pl.when(clipped if guard is None else (guard & clipped))
                 def _():
                     for c in range(C):
-                        vwin[c, sl] = jnp.zeros((wh, ww), vwin.dtype)
+                        vwin[_i32(c), sl] = jnp.zeros((wh, ww), vwin.dtype)
 
             for cond, cp in copies_for(ti, tj, sl):
                 g = guard if cond is None else (
@@ -991,7 +997,7 @@ def _field_call(chans, names, flows, *, block, offsets, interpret, nsteps,
         inv_exact = len(offsets) & (len(offsets) - 1) == 0
 
         def window(c):
-            return vwin[c, slot, pl.ds(hr - nsteps, MH),
+            return vwin[_i32(c), slot, pl.ds(hr - nsteps, MH),
                         pl.ds(hc - nsteps, MW)].astype(jnp.float32)
 
         def write_out(cur):

@@ -47,6 +47,48 @@ CHIP_PEAKS: dict[str, dict[str, float]] = {
 }
 
 
+#: jax ``device_kind`` spellings that mean a chip already in the table.
+#: Letter suffixes denote DIFFERENT chips ('v5e' is the lite part, 'v5p'
+#: the full part, 'v4i' the inference part) — they must be mapped
+#: explicitly, never by prefix, or 'TPU v5e' would inherit v5p's 2765
+#: GB/s and report a ~3.4x-understated percent-of-peak.
+KIND_ALIASES: dict[str, str] = {
+    "TPU v5e": "TPU v5 lite",
+    "TPU v5p": "TPU v5",
+    "TPU v6e": "TPU v6 lite",
+}
+
+
+def _lookup_peaks(kind: str) -> dict[str, float]:
+    """Exact match, then the alias table, then the longest table key
+    that prefixes the reported ``device_kind`` AT A WORD BOUNDARY
+    ('TPU v4 pod slice' → 'TPU v4'; 'TPU v4i' does NOT match — a letter
+    suffix is a different chip). An unmatched TPU part warns once
+    instead of silently losing its percent-of-peak (round-4 ADVICE);
+    inventing the wrong ceiling would be worse than omitting it."""
+    k = " ".join(kind.split())
+    if k in CHIP_PEAKS:
+        return dict(CHIP_PEAKS[k])
+    if k in KIND_ALIASES:
+        return dict(CHIP_PEAKS[KIND_ALIASES[k]])
+    for key in sorted(CHIP_PEAKS, key=len, reverse=True):
+        if k.startswith(key + " "):
+            return dict(CHIP_PEAKS[key])
+    if "tpu" in k.lower() and k not in _WARNED_KINDS:
+        import warnings
+
+        _WARNED_KINDS.add(k)
+        warnings.warn(
+            f"unrecognized TPU device_kind {kind!r}: no peak table entry "
+            f"(known: {sorted(CHIP_PEAKS)}); percent-of-peak will be "
+            f"omitted — set MMTPU_HBM_PEAK_GBPS / MMTPU_VPU_PEAK_GOPS "
+            f"to supply peaks", stacklevel=3)
+    return {}
+
+
+_WARNED_KINDS: set[str] = set()
+
+
 def chip_peaks(device=None) -> Optional[dict[str, Any]]:
     """Peak table entry for ``device`` (default: first jax device), with
     env overrides applied; None for unknown parts (e.g. CPU test rigs —
@@ -57,7 +99,7 @@ def chip_peaks(device=None) -> Optional[dict[str, Any]]:
     if device is None:
         device = jax.devices()[0]
     kind = getattr(device, "device_kind", "")
-    peaks = dict(CHIP_PEAKS.get(kind, {}))
+    peaks = _lookup_peaks(kind)
     hbm = os.environ.get("MMTPU_HBM_PEAK_GBPS")
     vpu = os.environ.get("MMTPU_VPU_PEAK_GOPS")
     if hbm:

@@ -6,6 +6,8 @@ and sign are asserted."""
 import sys
 from pathlib import Path
 
+import pytest
+
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 from benchmarks.ladder import config1, config2, oracle_cups  # noqa: E402
@@ -45,6 +47,37 @@ def test_roofline_fields():
     # CPU test rig: device_kind unknown → no percent-of-peak invented
     assert r1["pct_of_hbm_peak"] is None or isinstance(
         r1["pct_of_hbm_peak"], float)
+
+
+def test_chip_peaks_prefix_matching_and_unknown_warning():
+    """device_kind strings drift across TPU generations: 'TPU v5p' and
+    'TPU v5e' resolve via the ALIAS table to the right chips (letter
+    suffixes are different parts — prefix matching would hand v5e the
+    v5p peaks), word-boundary prefixes match ('TPU v4 pod slice'), a
+    letter suffix with no alias ('TPU v4i' — a genuinely different
+    inference chip) warns rather than inheriting wrong peaks, and
+    unknown TPU kinds warn instead of silently dropping the
+    percent-of-peak (round-4 ADVICE)."""
+    import warnings
+
+    from mpi_model_tpu.utils.roofline import CHIP_PEAKS, _lookup_peaks
+
+    assert _lookup_peaks("TPU v5 lite") == CHIP_PEAKS["TPU v5 lite"]
+    assert _lookup_peaks("TPU v5p") == CHIP_PEAKS["TPU v5"]
+    assert _lookup_peaks("TPU v5e") == CHIP_PEAKS["TPU v5 lite"]
+    assert _lookup_peaks("TPU v4 pod slice") == CHIP_PEAKS["TPU v4"]
+    assert _lookup_peaks("TPU  v5   lite") == CHIP_PEAKS["TPU v5 lite"]
+    with pytest.warns(UserWarning, match="unrecognized TPU device_kind"):
+        assert _lookup_peaks("TPU v4i") == {}
+    with pytest.warns(UserWarning, match="unrecognized TPU device_kind"):
+        assert _lookup_peaks("TPU v99 hyper") == {}
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # second lookup: warn ONCE only
+        assert _lookup_peaks("TPU v99 hyper") == {}
+    # non-TPU kinds (CPU rigs) stay silent
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert _lookup_peaks("Host CPU") == {}
 
 
 def test_chip_peaks_env_override(monkeypatch):

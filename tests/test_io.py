@@ -264,6 +264,68 @@ def test_manager_restore_autodetects_layout(tmp_path):
                                   np.asarray(space.values["value"]))
 
 
+def test_manager_prefers_configured_layout_when_both_exist(tmp_path):
+    """A run that switched layouts and re-saved one step leaves BOTH a
+    .npz and a committed .ckpt on disk; restore must pick the layout the
+    manager is configured with (and warn), not silently the .npz
+    (round-4 ADVICE: the stale-layout file may hold old state)."""
+    old = random_space(6, 6)
+    new = random_space(6, 6)
+    dense_mgr = CheckpointManager(str(tmp_path / "ck"), layout="full")
+    dense_mgr.save(old, step=5)
+    sharded_mgr = CheckpointManager(str(tmp_path / "ck"), layout="sharded")
+    sharded_mgr.save(new, step=5)  # same step, fresher state
+    with pytest.warns(UserWarning, match="BOTH layouts"):
+        ck = sharded_mgr.restore(5)
+    np.testing.assert_array_equal(np.asarray(ck.space.values["value"]),
+                                  np.asarray(new.values["value"]))
+    # the dense manager (its own layout now stale) symmetrically prefers
+    # ITS configured layout — with the same warning to surface the split
+    with pytest.warns(UserWarning, match="BOTH layouts"):
+        ck_dense = dense_mgr.restore(5)
+    np.testing.assert_array_equal(np.asarray(ck_dense.space.values["value"]),
+                                  np.asarray(old.values["value"]))
+
+
+def test_prune_clears_both_layouts_of_an_aged_step(tmp_path):
+    """Pruning a step that exists in both layouts removes BOTH files —
+    leaving the stale other-layout file behind would resurrect it as
+    that step's sole (warning-free) checkpoint."""
+    space = random_space(6, 6)
+    CheckpointManager(str(tmp_path / "ck"), layout="full").save(space, step=1)
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2, layout="sharded")
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the intentional both-layouts split
+        mgr.save(space, step=1)
+        mgr.save(space, step=2)
+        mgr.save(space, step=3)  # ages step 1 out
+    assert mgr.steps() == [2, 3]
+    assert not os.path.exists(mgr.path_for(1, "full"))
+    assert not os.path.exists(mgr.path_for(1, "sharded"))
+
+
+def test_sharded_resave_clears_stale_shard_files(tmp_path):
+    """Re-saving into an existing .ckpt dir drops shard files a previous
+    larger-process_count save left behind: every file in the directory
+    is referenced by the new manifest (round-4 ADVICE)."""
+    import json
+
+    space = random_space(6, 6)
+    path = str(tmp_path / "one.ckpt")
+    save_checkpoint_sharded(path, space, step=1)
+    # simulate a stale shard from an earlier 3-process save
+    stale = tmp_path / "one.ckpt" / "shards_p00002.npz"
+    stale.write_bytes(b"junk")
+    save_checkpoint_sharded(path, space, step=2)
+    assert not stale.exists()
+    with open(tmp_path / "one.ckpt" / "manifest.json") as f:
+        manifest = json.load(f)
+    on_disk = {p.name for p in (tmp_path / "one.ckpt").iterdir()}
+    assert on_disk == set(manifest["files"]) | {"manifest.json"}
+
+
 def test_incomplete_sharded_checkpoint_falls_back(tmp_path):
     """A crash mid-save leaves a manifest-less .ckpt dir; latest() must
     resume from the previous COMPLETE checkpoint, and the next save

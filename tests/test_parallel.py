@@ -742,3 +742,19 @@ def test_model_rectangular_geometry_follows_executed_mesh(eight_devices):
     parts = model.partitions(space)
     assert len(parts) == 6
     assert parts[1].describe() == "0|8:8|8"  # 2x3 blocks of 8x8
+
+
+def test_model_rectangular_geometry_follows_explicit_executor(eight_devices):
+    """A user-built ShardMapExecutor passed straight to execute() (never
+    via default_executor) must ALSO become the geometry source of truth:
+    owner_of/partitions describe the mesh that ran, not a re-inference
+    from all 8 visible devices (round-4 ADVICE)."""
+    model = ModelRectangular(Diffusion(0.1), 2.0, 1.0, lines=2)
+    space = CellularSpace.create(16, 24, 1.0, dtype="float64")
+    mesh = make_mesh_2d(2, 3, devices=eight_devices[:6])
+    ex = ShardMapExecutor(mesh)
+    out, rep = model.execute(space, ex)
+    assert rep.comm_size == 6
+    parts = model.partitions(space)
+    assert len(parts) == 6  # 2x3, the executed mesh — not 2x4
+    assert parts[1].describe() == "0|8:8|8"
