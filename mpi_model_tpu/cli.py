@@ -28,52 +28,83 @@ import sys
 from typing import Optional
 
 
-def _build_model(args):
-    import jax.numpy as jnp
-
-    from . import (
-        Attribute, Cell, CellularSpace, Diffusion, Exponencial, Model,
-    )
-
-    dtype = {"float32": jnp.float32, "float64": jnp.float64,
-             "bfloat16": jnp.bfloat16}[args.dtype]
-    space = CellularSpace.create(args.dimx, args.dimy, args.init,
-                                 dtype=dtype)
-    if args.flow == "exponencial":
-        sx, sy = (int(v) for v in args.source.split(","))
-        flow = Exponencial(Cell(sx, sy, Attribute(99, args.value)),
-                           args.rate)
-    elif args.flow == "diffusion":
-        flow = Diffusion(args.rate)
-    else:
-        raise SystemExit(f"unknown --flow={args.flow!r} "
-                         "(expected exponencial|diffusion)")
-    model = Model(flow, args.time, args.time_step)
-    return space, model
-
-
-def _build_executor(args):
-    if args.mesh is None:
-        from .models.model import SerialExecutor
-
-        return SerialExecutor(step_impl=args.impl, substeps=args.substeps)
-
-    import jax
-
-    from .parallel import ShardMapExecutor, make_mesh, make_mesh_2d
-
+def _parse_grid2(text, flag):
+    """'N' or 'LxC' → (lines, columns), positive."""
     try:
-        parts = [int(v) for v in args.mesh.lower().split("x")]
-        if len(parts) == 1:  # "--mesh=N" = 1-D row stripes (Model.hpp:62-76)
+        parts = [int(v) for v in text.lower().split("x")]
+        if len(parts) == 1:  # "N" = 1-D row stripes (Model.hpp:62-76)
             parts.append(1)
         lines, columns = parts
         if lines < 1 or columns < 1:
             raise ValueError
     except ValueError:
         raise SystemExit(
-            f"--mesh={args.mesh!r} is not N or LxC with positive extents "
-            "(e.g. --mesh=4, --mesh=2x4)")
-    n = lines * columns
+            f"{flag}={text!r} is not N or LxC with positive extents "
+            f"(e.g. {flag}=4, {flag}=2x4)")
+    return lines, columns
+
+
+def _compute_dtype(args):
+    if args.compute_dtype is None:
+        return None
+    import jax.numpy as jnp
+
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        args.compute_dtype]
+
+
+def _build_model(args):
+    import jax.numpy as jnp
+
+    from . import (
+        Attribute, Cell, CellularSpace, Coupled, Diffusion, Exponencial,
+        Model, ModelRectangular,
+    )
+
+    dtype = {"float32": jnp.float32, "float64": jnp.float64,
+             "bfloat16": jnp.bfloat16}[args.dtype]
+    init_spec = args.init
+    if args.flow == "exponencial":
+        sx, sy = (int(v) for v in args.source.split(","))
+        flow = Exponencial(Cell(sx, sy, Attribute(99, args.value)),
+                           args.rate)
+    elif args.flow == "diffusion":
+        flow = Diffusion(args.rate)
+    elif args.flow == "coupled":
+        # the config-4 workload shape: N channels, each diffusing AND
+        # shedding mass modulated by the next channel (a coupling ring) —
+        # the multi-attribute case the fused FIELD kernel exists for
+        if args.channels < 2:
+            raise SystemExit("--flow=coupled needs --channels >= 2 "
+                             "(a channel modulated by itself is just "
+                             "quadratic diffusion)")
+        names = [f"c{i}" for i in range(args.channels)]
+        flow = []
+        for i, nm in enumerate(names):
+            flow.append(Diffusion(args.rate, attr=nm))
+            flow.append(Coupled(flow_rate=args.rate / 2, attr=nm,
+                                modulator=names[(i + 1) % len(names)]))
+        init_spec = {nm: args.init for nm in names}
+    else:
+        raise SystemExit(f"unknown --flow={args.flow!r} "
+                         "(expected exponencial|diffusion|coupled)")
+    space = CellularSpace.create(args.dimx, args.dimy, init_spec,
+                                 dtype=dtype)
+    if args.rect_grid is not None:
+        lines, columns = args.rect_grid
+        model = ModelRectangular(flow, args.time, args.time_step,
+                                 lines=lines, columns=columns,
+                                 step_impl=args.impl,
+                                 halo_depth=args.halo_depth,
+                                 compute_dtype=_compute_dtype(args))
+    else:
+        model = Model(flow, args.time, args.time_step)
+    return space, model
+
+
+def _pick_devices(n: int, hint_flag: str):
+    import jax
+
     devices = jax.devices()
     if len(devices) < n:
         cpus = jax.devices("cpu")
@@ -81,16 +112,49 @@ def _build_executor(args):
             devices = cpus
         else:
             raise SystemExit(
-                f"--mesh={args.mesh} needs {n} devices; have "
+                f"{hint_flag} needs {n} devices; have "
                 f"{len(devices)} (hint: XLA_FLAGS="
                 f"--xla_force_host_platform_device_count={n} "
                 "JAX_PLATFORMS=cpu for a virtual mesh)")
+    return devices[:n]
+
+
+def _build_executor(args, model):
+    if args.rect_grid is not None:
+        # ModelRectangular owns its executor: a ShardMapExecutor over
+        # the lines × columns block mesh, which also becomes the
+        # owner_of / per-block-output geometry source of truth
+        lines, columns = args.rect_grid
+        return model.default_executor(
+            devices=_pick_devices(lines * columns, "--rectangular"))
+
+    if args.mesh is None:
+        from .models.model import SerialExecutor
+
+        return SerialExecutor(step_impl=args.impl, substeps=args.substeps,
+                              compute_dtype=_compute_dtype(args))
+
+    lines, columns = _parse_grid2(args.mesh, "--mesh")
+    n = lines * columns
+    devices = _pick_devices(n, f"--mesh={args.mesh}")
+
+    from .parallel import (AutoShardedExecutor, ShardMapExecutor, make_mesh,
+                           make_mesh_2d)
+
     if lines == 1 or columns == 1:
-        mesh = make_mesh(n, devices=devices[:n])
+        mesh = make_mesh(n, devices=devices)
     else:
-        mesh = make_mesh_2d(lines, columns, devices=devices[:n])
+        mesh = make_mesh_2d(lines, columns, devices=devices)
+    if args.executor == "gspmd":
+        # the GSPMD path: the global XLA step with sharding annotations —
+        # XLA inserts the halo collectives. Slower than the explicit
+        # ppermute path on the measured ladder (BASELINE config 3) but
+        # runs ANY flow unchanged, including footprint="unknown" user
+        # flows ShardMapExecutor refuses.
+        return AutoShardedExecutor(mesh)
     return ShardMapExecutor(mesh, step_impl=args.impl,
-                            halo_depth=args.halo_depth)
+                            halo_depth=args.halo_depth,
+                            compute_dtype=_compute_dtype(args))
 
 
 def cmd_run(args) -> int:
@@ -101,16 +165,52 @@ def cmd_run(args) -> int:
     # inapplicable flag combinations are errors, not silent no-ops — a
     # user must not believe they benchmarked a configuration that never
     # ran
-    if args.mesh is None and args.halo_depth != 1:
+    sharded = args.mesh is not None or args.rectangular is not None
+    if not sharded and args.halo_depth != 1:
         raise SystemExit(
-            "--halo-depth applies to sharded execution; add --mesh=LxC")
-    if args.mesh is not None and args.substeps != 1:
+            "--halo-depth applies to sharded execution; add --mesh=LxC "
+            "or --rectangular=LxC")
+    if sharded and args.substeps != 1:
         raise SystemExit(
-            "--substeps applies to the serial executor; with --mesh use "
-            "--halo-depth for the analogous fusion")
+            "--substeps applies to the serial executor; for sharded runs "
+            "use --halo-depth for the analogous fusion")
+    if args.rectangular is not None and args.mesh is not None:
+        raise SystemExit(
+            "--rectangular IS the mesh (a lines x columns block "
+            "decomposition); drop --mesh")
+    if args.executor == "gspmd":
+        if args.mesh is None:
+            raise SystemExit("--executor=gspmd is a sharded path; add "
+                             "--mesh=LxC")
+        if args.impl == "pallas":
+            raise SystemExit(
+                "--executor=gspmd runs the global XLA step (XLA inserts "
+                "the collectives); the Pallas halo kernels need "
+                "--executor=shardmap")
+        if args.halo_depth != 1 or args.compute_dtype is not None:
+            raise SystemExit(
+                "--halo-depth/--compute-dtype tune the explicit "
+                "ShardMapExecutor; --executor=gspmd delegates both to XLA")
+    if args.executor == "shardmap" and not sharded:
+        raise SystemExit("--executor=shardmap needs --mesh=LxC")
+    if args.executor == "serial" and sharded:
+        raise SystemExit("--executor=serial contradicts "
+                         "--mesh/--rectangular")
+    if args.channels != 2 and args.flow != "coupled":
+        raise SystemExit("--channels applies to --flow=coupled")
+    if args.owner_of is not None and args.rectangular is None:
+        raise SystemExit(
+            "--owner-of reports the 2-D block owner map; add "
+            "--rectangular=LxC")
+    if args.compute_dtype is not None and args.impl == "xla":
+        raise SystemExit(
+            "--compute-dtype tunes the Pallas kernels' interior math; "
+            "--impl=xla never runs them (use --impl=pallas or auto)")
+    args.rect_grid = (_parse_grid2(args.rectangular, "--rectangular")
+                      if args.rectangular is not None else None)
 
     space, model = _build_model(args)
-    executor = _build_executor(args)
+    executor = _build_executor(args, model)
     steps = args.steps if args.steps is not None else model.num_steps
     initial = {k: float(space.total(k)) for k in space.values}
 
@@ -157,14 +257,18 @@ def cmd_run(args) -> int:
 
     # the kernel that ACTUALLY ran (after any "auto" fallback) — without
     # this a silent fallback means the user benchmarked a configuration
-    # that never ran (round-3 VERDICT weak #2)
+    # that never ran (round-3 VERDICT weak #2). --rectangular IS a
+    # sharded run (a lines x columns block mesh), so the backend label
+    # and the halo_depth/substeps applicability follow `sharded`, not
+    # --mesh alone.
     impl_used = getattr(executor, "last_impl", None)
     run_cfg = {"impl": impl_used,
-               "halo_depth": args.halo_depth if args.mesh else None,
-               "substeps": args.substeps if not args.mesh else None}
+               "halo_depth": args.halo_depth if sharded else None,
+               "substeps": args.substeps if not sharded else None,
+               "rectangular": args.rectangular}
 
     if failure is not None:
-        result = {"backend": "sharded" if args.mesh else "serial",
+        result = {"backend": "sharded" if sharded else "serial",
                   "ranks": ranks, "steps": steps, "conserved": False,
                   "error": failure, "recovered_failures": len(events),
                   "wall_s": wall, **run_cfg}
@@ -173,10 +277,23 @@ def cmd_run(args) -> int:
         return 1
 
     if args.output:
-        from .io import write_output
+        if args.rectangular:
+            # per-BLOCK dump + master merge following the executed
+            # lines x columns mesh (the output stage the reference's 2-D
+            # variant left commented out, ModelRectangular.hpp:235-270)
+            merged = model.write_output(args.output, out)
+        else:
+            from .io import write_output
 
-        merged = write_output(args.output, out, comm_size=max(ranks, 1))
+            merged = write_output(args.output, out, comm_size=max(ranks, 1))
         print(f"output written to {merged}", file=sys.stderr)
+    if args.owner_of is not None:
+        x, y = (int(v) for v in args.owner_of.split(","))
+        print(json.dumps({
+            "cell": [x, y],
+            "owner": model.owner_of(x, y, out),
+            "partitions": [p.describe() for p in model.partitions(out)],
+        }))
     if args.trace:
         get_tracer().export_chrome(args.trace)
         print(f"trace written to {args.trace}", file=sys.stderr)
@@ -187,7 +304,7 @@ def cmd_run(args) -> int:
     err = max(abs(final[k] - initial[k]) for k in initial)
     thresh = model.conservation_threshold(space, initial_totals=initial)
     result = {
-        "backend": "sharded" if args.mesh else "serial",
+        "backend": "sharded" if sharded else "serial",
         "ranks": ranks,
         "steps": steps,
         "initial": initial,
@@ -250,7 +367,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     run.add_argument("--dimy", type=int, default=100)
     run.add_argument("--init", type=float, default=1.0)
     run.add_argument("--flow", default="exponencial",
-                     choices=["exponencial", "diffusion"])
+                     choices=["exponencial", "diffusion", "coupled"])
+    run.add_argument("--channels", type=int, default=2,
+                     help="channel count for --flow=coupled (a ring of "
+                     "N diffusing channels, each modulated by the next "
+                     "— the config-4 multi-attribute workload)")
     run.add_argument("--source", default="19,3",
                      help="point-flow source cell x,y")
     run.add_argument("--rate", type=float, default=0.1)
@@ -265,11 +386,30 @@ def main(argv: Optional[list[str]] = None) -> int:
                      choices=["float32", "float64", "bfloat16"])
     run.add_argument("--impl", default="auto",
                      choices=["xla", "pallas", "auto"])
+    run.add_argument("--compute-dtype", default=None,
+                     choices=["float32", "bfloat16"],
+                     help="Pallas interior-tile math dtype (default f32; "
+                     "bfloat16 trades interior precision for VPU "
+                     "throughput; the near-ring exact path stays f32)")
     run.add_argument("--substeps", type=int, default=1,
                      help="fused steps per compiled call (serial executor)")
     run.add_argument("--mesh", default=None,
                      help="LxC device mesh for sharded execution "
                      "(e.g. 4x1, 2x4); omit for serial")
+    run.add_argument("--executor", default="auto",
+                     choices=["auto", "serial", "shardmap", "gspmd"],
+                     help="'auto' = serial without --mesh, shardmap with "
+                     "it; 'gspmd' = AutoShardedExecutor (global XLA step, "
+                     "XLA inserts the halo collectives — runs ANY flow, "
+                     "including footprint='unknown' user flows the "
+                     "explicit shardmap path refuses)")
+    run.add_argument("--rectangular", default=None, metavar="LxC",
+                     help="run ModelRectangular over a lines x columns "
+                     "2-D block mesh (the reference's rectangular demo); "
+                     "--output writes per-BLOCK rank files")
+    run.add_argument("--owner-of", default=None, metavar="X,Y",
+                     help="with --rectangular: print the block-owner "
+                     "rank of global cell (X,Y) and the partition map")
     run.add_argument("--halo-depth", type=int, default=1,
                      help="ghost-ring depth d: one exchange per d steps")
     run.add_argument("--checkpoint-dir", default=None)

@@ -104,9 +104,14 @@ class SerialExecutor:
 
     comm_size = 1
 
-    def __init__(self, step_impl: str = "xla", substeps: int = 1):
+    def __init__(self, step_impl: str = "xla", substeps: int = 1,
+                 compute_dtype=None):
         self.step_impl = step_impl
         self.substeps = max(1, int(substeps))
+        #: interior-tile window math dtype for the Pallas kernels
+        #: (None → f32; ``Model.make_step(compute_dtype=...)``); the XLA
+        #: path ignores it
+        self.compute_dtype = compute_dtype
         #: kernel the last run actually used ("pallas"/"xla"), after any
         #: "auto" fallback — the CLI/bench report it so a user never
         #: believes they measured a configuration that never ran
@@ -148,8 +153,12 @@ class SerialExecutor:
         # q multi-step calls + r single-step calls == num_steps steps
         q, r = divmod(num_steps, self.substeps)
         stepk = model.make_step(space, impl=self.step_impl,
-                                substeps=self.substeps) if q else None
-        step1 = model.make_step(space, impl=self.step_impl) if r else None
+                                substeps=self.substeps,
+                                compute_dtype=self.compute_dtype
+                                ) if q else None
+        step1 = model.make_step(space, impl=self.step_impl,
+                                compute_dtype=self.compute_dtype
+                                ) if r else None
         step_any = stepk or step1
         # num_steps=0 builds no step at all — nothing ran, report None
         self.last_impl = step_any.impl if step_any is not None else None
@@ -228,7 +237,8 @@ class Model:
         return jnp.dtype(space.dtype).itemsize <= 4
 
     def make_step(self, space: CellularSpace, impl: str = "xla",
-                  substeps: int = 1) -> Callable[[Values], Values]:
+                  substeps: int = 1,
+                  compute_dtype=None) -> Callable[[Values], Values]:
         """Build the pure per-step function for this space's geometry.
 
         Point-source flows take the sparse scatter path
@@ -254,7 +264,13 @@ class Model:
         requires Diffusion-only models, since a point flow must fire
         between sub-steps); elsewhere the single step is composed
         ``substeps`` times inside one jitted call, which is semantically
-        identical to calling the step repeatedly."""
+        identical to calling the step repeatedly.
+
+        ``compute_dtype`` (Pallas paths only; None → f32) sets the
+        INTERIOR-tile window math dtype of the fused kernels —
+        ``bfloat16`` trades interior precision for VPU throughput; the
+        near-ring exact path always computes in f32. The XLA path
+        ignores it (its math runs in the storage dtype)."""
         if not jnp.issubdtype(space.dtype, jnp.floating):
             raise TypeError(
                 f"flow transport requires a floating dtype, got {space.dtype}"
@@ -266,6 +282,7 @@ class Model:
             raise ValueError(f"substeps must be >= 1, got {substeps}")
         key = (space.shape, space.global_shape, (space.x_init, space.y_init),
                str(space.dtype), self.offsets, impl, substeps,
+               str(compute_dtype) if compute_dtype is not None else None,
                tuple(f.fingerprint() for f in self.flows))
         cached = self._step_cache.get(key)
         if cached is not None:
@@ -341,7 +358,8 @@ class Model:
                                               dtype=space.dtype,
                                               offsets=offsets,
                                               interpret=interp,
-                                              nsteps=substeps)
+                                              nsteps=substeps,
+                                              compute_dtype=compute_dtype)
                     for attr, rate in rates.items() if rate != 0.0}
             elif field_eligible:
                 # general pointwise flows (Coupled, user flows): the
@@ -350,7 +368,8 @@ class Model:
                 from ..ops.pallas_stencil import PallasFieldStep
                 pallas_field_stepper = PallasFieldStep(
                     space.shape, field_flows, dtype=space.dtype,
-                    offsets=offsets, interpret=interp, nsteps=substeps)
+                    offsets=offsets, interpret=interp, nsteps=substeps,
+                    compute_dtype=compute_dtype)
             if (pallas_steppers is not None
                     or pallas_field_stepper is not None) and impl == "auto":
                 # Static eligibility can't prove the kernel will actually

@@ -30,15 +30,18 @@ class ModelRectangular(Model):
 
     def __init__(self, flow, time: float = 1.0, time_step: float = 1.0, *,
                  lines: Optional[int] = None, columns: Optional[int] = None,
-                 offsets=None, step_impl: str = "xla", halo_depth: int = 1):
+                 offsets=None, step_impl: str = "xla", halo_depth: int = 1,
+                 compute_dtype=None):
         super().__init__(flow, time, time_step, offsets=offsets)
         self.lines = lines
         self.columns = columns
         #: passed through to the default ShardMapExecutor: the per-shard
-        #: kernel ("xla" | "pallas" | "auto") and the deep-halo depth
-        #: (one ghost exchange per ``halo_depth`` steps)
+        #: kernel ("xla" | "pallas" | "auto"), the deep-halo depth
+        #: (one ghost exchange per ``halo_depth`` steps), and the Pallas
+        #: interior-math dtype
         self.step_impl = step_impl
         self.halo_depth = halo_depth
+        self.compute_dtype = compute_dtype
 
     # -- the reference's (commented-out) demo scenario ---------------------
 
@@ -122,7 +125,8 @@ class ModelRectangular(Model):
 
         mesh = make_mesh_2d(self.lines, self.columns, devices=devices)
         self._default_executor = ShardMapExecutor(
-            mesh, step_impl=self.step_impl, halo_depth=self.halo_depth)
+            mesh, step_impl=self.step_impl, halo_depth=self.halo_depth,
+            compute_dtype=self.compute_dtype)
         return self._default_executor
 
     def execute(self, space, executor=None, **kw):

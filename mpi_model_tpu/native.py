@@ -61,7 +61,7 @@ def load_library():
     if _lib is not None:
         return _lib
     lib = ctypes.CDLL(build_native())
-    if not hasattr(lib, "mmtpu_selftest_recv_timeout"):
+    if not hasattr(lib, "mmtpu_space_create_typed"):  # ABI v2 marker
         # stale libmmtpu.so from an older source tree: rebuild, then load
         # the fresh binary under a UNIQUE path — dlopen would hand back
         # the already-mapped stale object for the original path
@@ -78,20 +78,29 @@ def load_library():
             # the dlopen mapping survives the unlink on Linux; without
             # this every affected process leaks one temp .so on disk
             os.unlink(tmp)
-        if not hasattr(lib, "mmtpu_selftest_recv_timeout"):
+        if not hasattr(lib, "mmtpu_space_create_typed"):
             raise RuntimeError(
                 "libmmtpu.so is stale and rebuilding did not refresh it; "
                 "remove native/build and retry")
     lib.mmtpu_last_error.restype = ctypes.c_char_p
     lib.mmtpu_abi_version.restype = ctypes.c_int
     lib.mmtpu_dtype_tag_float64.restype = ctypes.c_int
+    lib.mmtpu_dtype_tag_float32.restype = ctypes.c_int
     lib.mmtpu_space_create.restype = ctypes.c_void_p
     lib.mmtpu_space_create.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_double,
         ctypes.POINTER(ctypes.c_char_p), ctypes.c_int]
+    lib.mmtpu_space_create_typed.restype = ctypes.c_void_p
+    lib.mmtpu_space_create_typed.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_double,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int]
+    lib.mmtpu_space_dtype.restype = ctypes.c_int
+    lib.mmtpu_space_dtype.argtypes = [ctypes.c_void_p]
     lib.mmtpu_space_destroy.argtypes = [ctypes.c_void_p]
     lib.mmtpu_space_channel.restype = ctypes.POINTER(ctypes.c_double)
     lib.mmtpu_space_channel.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.mmtpu_space_channel_f32.restype = ctypes.POINTER(ctypes.c_float)
+    lib.mmtpu_space_channel_f32.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.mmtpu_space_total.restype = ctypes.c_double
     lib.mmtpu_space_total.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.mmtpu_space_set.restype = ctypes.c_int
@@ -110,8 +119,10 @@ def load_library():
         ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double)]
     lib.mmtpu_selftest_recv_timeout.restype = ctypes.c_int
     lib.mmtpu_selftest_recv_timeout.argtypes = [ctypes.c_int]
+    lib.mmtpu_selftest_typed_wire.restype = ctypes.c_int
     # ABI pin: the native dtype tags must match abstraction.DataType.
     assert lib.mmtpu_dtype_tag_float64() == to_native(DataType.FLOAT64)
+    assert lib.mmtpu_dtype_tag_float32() == to_native(DataType.FLOAT32)
     _lib = lib
     return lib
 
@@ -122,6 +133,18 @@ def selftest_recv_timeout(timeout_ms: int = 100) -> bool:
     the engine (returned here as True). The reference in the same
     situation hangs forever (SURVEY §5: 'a failed rank = hung job')."""
     rc = load_library().mmtpu_selftest_recv_timeout(int(timeout_ms))
+    if rc == -1:
+        raise RuntimeError(
+            f"native selftest errored: "
+            f"{load_library().mmtpu_last_error().decode()}")
+    return rc == 1
+
+
+def selftest_typed_wire() -> bool:
+    """Drive the typed wire: an f32 payload received as f64 must raise
+    the dtype-mismatch error inside the engine, and the matching-type
+    path must round-trip (True = both held)."""
+    rc = load_library().mmtpu_selftest_typed_wire()
     if rc == -1:
         raise RuntimeError(
             f"native selftest errored: "
@@ -159,21 +182,37 @@ def _flow_specs(flows) -> tuple:
 
 
 class NativeSpace:
-    """RAII wrapper over mmtpu_space with zero-copy channel views."""
+    """RAII wrapper over mmtpu_space with zero-copy TYPED channel views.
+
+    ``dtype`` selects the engine instantiation (float64 — the
+    reference's ``double`` default — or float32): field math runs in
+    the storage type; conservation totals accumulate in f64 either way."""
+
+    _DTYPES = {"float64": (DataType.FLOAT64, np.float64),
+               "float32": (DataType.FLOAT32, np.float32)}
 
     def __init__(self, dim_x: int, dim_y: int, init: float = 1.0,
-                 attrs: tuple[str, ...] = ("value",)):
+                 attrs: tuple[str, ...] = ("value",),
+                 dtype: str = "float64"):
         self._lib = load_library()
+        if str(dtype) not in self._DTYPES:
+            raise ValueError(
+                f"native engine instantiates float32/float64, not {dtype!r}")
+        tag, self.np_dtype = self._DTYPES[str(dtype)]
+        self.dtype = str(dtype)
         arr = (ctypes.c_char_p * len(attrs))(*[a.encode() for a in attrs])
-        self._ptr = self._lib.mmtpu_space_create(
-            dim_x, dim_y, float(init), arr, len(attrs))
+        self._ptr = self._lib.mmtpu_space_create_typed(
+            dim_x, dim_y, float(init), arr, len(attrs), to_native(tag))
         if not self._ptr:
             raise RuntimeError(self._lib.mmtpu_last_error().decode())
+        assert self._lib.mmtpu_space_dtype(self._ptr) == to_native(tag)
         self.shape = (dim_x, dim_y)
         self.attrs = attrs
 
     def channel(self, attr: str = "value") -> np.ndarray:
-        p = self._lib.mmtpu_space_channel(self._ptr, attr.encode())
+        fn = (self._lib.mmtpu_space_channel if self.dtype == "float64"
+              else self._lib.mmtpu_space_channel_f32)
+        p = fn(self._ptr, attr.encode())
         if not p:
             raise KeyError(self._lib.mmtpu_last_error().decode())
         return np.ctypeslib.as_array(p, shape=self.shape)
@@ -213,8 +252,9 @@ class NativeSpace:
 
 class NativeExecutor:
     """Runs a Model on the native C++ engine (serial or threaded ranks)
-    through the standard Executor protocol. f64 only (the native engine's
-    storage type)."""
+    through the standard Executor protocol. f32 spaces run the native
+    f32 engine instantiation (true f32 math — golden-tested against the
+    f32 JAX path); every other dtype runs the f64 engine."""
 
     def __init__(self, lines: int = 1, columns: int = 1):
         self.lines = lines
@@ -231,11 +271,13 @@ class NativeExecutor:
     def run_model(self, model, space: CellularSpace, num_steps: int) -> dict:
         import jax.numpy as jnp
 
+        native_dtype = ("float32" if jnp.dtype(space.dtype) == jnp.float32
+                        else "float64")
         ns = NativeSpace(space.dim_x, space.dim_y, 0.0,
-                         attrs=tuple(space.values))
+                         attrs=tuple(space.values), dtype=native_dtype)
         for attr in space.values:
             np.copyto(ns.channel(attr),
-                      np.asarray(space.values[attr], dtype=np.float64))
+                      np.asarray(space.values[attr], dtype=ns.np_dtype))
         self.last_backend_report = ns.run(
             model.flows, num_steps, self.lines, self.columns,
             check_conservation=False)

@@ -539,13 +539,15 @@ def _pallas_step(v: jax.Array, *, rate: float,
 
 @functools.partial(jax.jit,
                    static_argnames=("rate", "block", "offsets", "interpret",
-                                    "global_shape", "nsteps"))
+                                    "global_shape", "nsteps",
+                                    "compute_dtype"))
 def _pallas_halo_step(v, n, s, w_col, e_col, nw, ne, sw, se, origin, *,
                       rate: float, block: tuple[int, int],
                       offsets: tuple[tuple[int, int], ...],
                       interpret: bool,
                       global_shape: tuple[int, int],
-                      nsteps: int = 1) -> jax.Array:
+                      nsteps: int = 1,
+                      compute_dtype=jnp.float32) -> jax.Array:
     """Assemble the raw depth-d ghost ring into piece-granularity slabs
     and run the halo-mode kernel (see ``_stencil_call``). The ring depth
     d = n.shape[0]; ghost cells sit INNERMOST in each slab (adjacent to
@@ -575,7 +577,7 @@ def _pallas_halo_step(v, n, s, w_col, e_col, nw, ne, sw, se, origin, *,
     return _stencil_call(v, (nslab, sslab, wfull, efull, origin),
                          rate=rate, block=block, offsets=offsets,
                          interpret=interpret, global_shape=global_shape,
-                         nsteps=nsteps)
+                         nsteps=nsteps, compute_dtype=compute_dtype)
 
 
 def pallas_halo_step(
@@ -588,6 +590,7 @@ def pallas_halo_step(
     block: Optional[tuple[int, int]] = None,
     interpret: Optional[bool] = None,
     nsteps: int = 1,
+    compute_dtype=None,
 ) -> jax.Array:
     """Per-shard fused flow step(s) consuming a ppermute ghost ring.
 
@@ -630,7 +633,8 @@ def pallas_halo_step(
         ring["nw"], ring["ne"], ring["sw"], ring["se"], origin,
         rate=float(rate), block=tuple(block), offsets=offsets,
         interpret=bool(interpret), global_shape=tuple(global_shape),
-        nsteps=int(nsteps))
+        nsteps=int(nsteps),
+        compute_dtype=jnp.dtype(compute_dtype or jnp.float32))
 
 
 def mesh_interpret(mesh) -> bool:
@@ -737,23 +741,26 @@ class PallasDiffusionStep:
                  offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS,
                  block: Optional[tuple[int, int]] = None,
                  interpret: Optional[bool] = None,
-                 nsteps: int = 1):
+                 nsteps: int = 1, compute_dtype=None):
         self.shape = shape
         self.rate = float(rate)
         self.offsets = check_offsets(offsets)
         self.block = block
         self.interpret = interpret
         self.nsteps = int(nsteps)
+        self.compute_dtype = compute_dtype
 
     def __call__(self, values: jax.Array) -> jax.Array:
         return pallas_dense_step(values, self.rate, self.offsets, self.block,
-                                 self.interpret, nsteps=self.nsteps)
+                                 self.interpret, nsteps=self.nsteps,
+                                 compute_dtype=self.compute_dtype)
 
 
 # -- general fused FIELD-FLOW kernel (multi-channel, any pointwise flow) -----
 
 def _field_call(chans, names, flows, *, block, offsets, interpret, nsteps,
-                halo_operands=None, global_shape=None):
+                halo_operands=None, global_shape=None,
+                compute_dtype=jnp.float32):
     """Fused multi-channel flow step for ARBITRARY pointwise field flows
     (``Coupled``, user flows — anything whose outflow reads only the
     cell's own channels).
@@ -996,9 +1003,9 @@ def _field_call(chans, names, flows, *, block, offsets, interpret, nsteps,
         # products differ in the last ulp.
         inv_exact = len(offsets) & (len(offsets) - 1) == 0
 
-        def window(c):
+        def window(c, cdt):
             return vwin[_i32(c), slot, pl.ds(hr - nsteps, MH),
-                        pl.ds(hc - nsteps, MW)].astype(jnp.float32)
+                        pl.ds(hc - nsteps, MW)].astype(cdt)
 
         def write_out(cur):
             for o, name in enumerate(out_names):
@@ -1012,9 +1019,12 @@ def _field_call(chans, names, flows, *, block, offsets, interpret, nsteps,
         near = ((g_r0 <= nsteps) | (g_r0 + bh >= H - nsteps)
                 | (g_c0 <= nsteps) | (g_c0 + bw >= W - nsteps))
 
+        # interior tiles may trade precision for VPU throughput via
+        # compute_dtype (mirroring _stencil_call's knob); the near-ring
+        # exact path always computes in f32
         @pl.when(jnp.logical_not(near))
         def _():
-            cur = {names[c]: window(c) for c in range(C)}
+            cur = {names[c]: window(c, compute_dtype) for c in range(C)}
             for s in range(nsteps):
                 hs, ws = MH - 2 * s, MW - 2 * s
                 org_s = (g_r0 - _i32(nsteps - s), g_c0 - _i32(nsteps - s))
@@ -1054,7 +1064,8 @@ def _field_call(chans, names, flows, *, block, offsets, interpret, nsteps,
                 cnt = cnt + ok.astype(jnp.float32)
             cnt = jnp.maximum(cnt, 1.0)
 
-            cur = {names[c]: window(c) * mask for c in range(C)}
+            cur = {names[c]: window(c, jnp.float32) * mask
+                   for c in range(C)}
             for s in range(nsteps):
                 hs, ws = MH - 2 * s, MW - 2 * s
                 m_s = mask[s:MH - s, s:MW - s]
@@ -1125,6 +1136,7 @@ def pallas_field_halo_step(
     block: Optional[tuple[int, int]] = None,
     interpret: Optional[bool] = None,
     nsteps: int = 1,
+    compute_dtype=None,
 ) -> dict:
     """Per-shard fused MULTI-CHANNEL field-flow step(s) consuming
     per-channel ppermute ghost rings — the sharded form of
@@ -1200,7 +1212,9 @@ def pallas_field_halo_step(
                        offsets=offsets, interpret=bool(interpret),
                        nsteps=int(nsteps),
                        halo_operands=(tuple(slabs), origin),
-                       global_shape=tuple(global_shape))
+                       global_shape=tuple(global_shape),
+                       compute_dtype=jnp.dtype(compute_dtype
+                                               or jnp.float32))
     flow_attrs = {f.attr for f in flows}
     out_names = tuple(n for n in names if n in flow_attrs)
     return {**values, **dict(zip(out_names, outs))}
@@ -1215,7 +1229,8 @@ class PallasFieldStep:
     def __init__(self, shape: tuple[int, int], flows, dtype=jnp.float32,
                  offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS,
                  block: Optional[tuple[int, int]] = None,
-                 interpret: Optional[bool] = None, nsteps: int = 1):
+                 interpret: Optional[bool] = None, nsteps: int = 1,
+                 compute_dtype=None):
         for f in flows:
             if getattr(f, "footprint", "unknown") != "pointwise":
                 raise ValueError(
@@ -1228,6 +1243,10 @@ class PallasFieldStep:
         self.block = block
         self.interpret = interpret
         self.nsteps = int(nsteps)
+        #: interior-tile window math dtype (None → f32); the near-ring
+        #: exact path always computes in f32 (same contract as
+        #: pallas_dense_step's knob)
+        self.compute_dtype = compute_dtype
         self._jitted = {}
 
     def __call__(self, values: dict) -> dict:
@@ -1247,6 +1266,7 @@ class PallasFieldStep:
             flows = self.flows
             offsets = self.offsets
             nsteps = self.nsteps
+            cdt = jnp.dtype(self.compute_dtype or jnp.float32)
 
             flow_attrs = {f.attr for f in flows}
             out_names = tuple(n for n in names if n in flow_attrs)
@@ -1257,7 +1277,7 @@ class PallasFieldStep:
                 outs = _field_call(chans, names, flows, block=block,
                                    offsets=offsets,
                                    interpret=bool(interpret),
-                                   nsteps=nsteps)
+                                   nsteps=nsteps, compute_dtype=cdt)
                 # modulator-only channels pass through untouched
                 return {**vals, **dict(zip(out_names, outs))}
 

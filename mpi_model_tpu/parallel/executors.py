@@ -123,7 +123,8 @@ class ShardMapExecutor:
     """
 
     def __init__(self, mesh: Mesh, step_impl: str = "xla",
-                 halo_mode: str = "exchange", halo_depth: int = 1):
+                 halo_mode: str = "exchange", halo_depth: int = 1,
+                 compute_dtype=None):
         if len(mesh.axis_names) not in (1, 2):
             raise ValueError("ShardMapExecutor needs a 1-D or 2-D mesh")
         if step_impl not in ("xla", "pallas", "auto"):
@@ -149,6 +150,11 @@ class ShardMapExecutor:
         #: Diffusion-only). Point flows need halo_depth=1 (they must
         #: fire between steps).
         self.halo_depth = int(halo_depth)
+        #: interior-tile window math dtype for the Pallas halo kernels
+        #: (None → f32; the near-ring exact path stays f32 — the same
+        #: knob as ``Model.make_step(compute_dtype=...)``); the XLA
+        #: shard step ignores it
+        self.compute_dtype = compute_dtype
         #: kernel the last ``run_model`` actually used ("pallas"/"xla"),
         #: after any "auto" fallback — reported by the CLI/bench
         self.last_impl: Optional[str] = None
@@ -580,6 +586,7 @@ class ShardMapExecutor:
                     else jnp.int32(0))
             origin = jnp.stack([row0, col0]).astype(jnp.int32)
 
+            cdt = self.compute_dtype
             if kind == "diffusion":
                 def chunk(c, ns):
                     """ns fused steps after one depth-``ns`` exchange
@@ -591,7 +598,8 @@ class ShardMapExecutor:
                             continue
                         new[attr] = pallas_halo_step(
                             c[attr], ring_of(c[attr], ns), origin, gshape,
-                            rate, offsets, interpret=interpret, nsteps=ns)
+                            rate, offsets, interpret=interpret, nsteps=ns,
+                            compute_dtype=cdt)
                     return new
             else:
                 def chunk(c, ns):
@@ -600,7 +608,7 @@ class ShardMapExecutor:
                     rings = {k: ring_of(v, ns) for k, v in c.items()}
                     return pallas_field_halo_step(
                         c, rings, origin, gshape, payload, offsets,
-                        interpret=interpret, nsteps=ns)
+                        interpret=interpret, nsteps=ns, compute_dtype=cdt)
 
             # dynamic trip count (n traced): q full-depth fused chunks,
             # then a switch over the possible remainder depths — each

@@ -278,6 +278,185 @@ else:
 """
 
 
+_SUPKILL_WORKER = r"""
+import os, sys
+sys.path.insert(0, {root!r})
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize overrides the env var
+jax.config.update("jax_enable_x64", True)  # real f64: bitwise resume proof
+from mpi_model_tpu.parallel import multihost
+multihost.initialize("127.0.0.1:{port}", num_processes={nprocs},
+                     process_id={pid})
+import numpy as np
+from jax.sharding import Mesh
+from mpi_model_tpu import CellularSpace, Diffusion, Model, PointFlow
+from mpi_model_tpu.io import CheckpointManager
+from mpi_model_tpu.io.checkpoint import run_checkpointed
+from mpi_model_tpu.parallel import ShardMapExecutor
+from mpi_model_tpu.parallel.collectives import gather_to_host
+
+N = {nprocs}
+assert jax.process_count() == N, jax.process_count()
+devs = jax.devices()
+assert len(devs) == 2 * N, devs  # 2 virtual CPU devices per process
+mesh = Mesh(np.array(devs).reshape(N, 2), ("x", "y"))
+
+h, w = 4 * N, 32
+space = CellularSpace.create(h, w, 1.0, dtype="float64")
+# the point source sits on a shard corner: its Moore shares cross BOTH
+# mesh axes (and hence process boundaries) every step
+model = Model([Diffusion(0.2), PointFlow(source=(h // 2 - 1, 15),
+                                         flow_rate=0.5)], 10.0, 1.0)
+mgr = CheckpointManager({ckpt_dir!r}, layout="sharded")
+ex = ShardMapExecutor(mesh)
+
+if {phase} == 1:
+    class CrashingExecutor:
+        '''Rank {kill_rank} dies HARD after computing the third chunk
+        (steps 5-6) but BEFORE its checkpoint commits — real work is
+        lost past the last durable step. Peers stop at the same logical
+        point with a distinct status (the cluster manager's teardown of
+        a job that lost a rank; detection itself is covered by the
+        supervisor health checks and the native RecvTimeout).'''
+
+        def __init__(self, inner):
+            self._inner = inner
+            self._steps = 0
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def run_model(self, model, space, n):
+            out = self._inner.run_model(model, space, n)
+            self._steps += n
+            if self._steps >= 6:
+                jax.block_until_ready(out)
+                if multihost.process_index() == {kill_rank}:
+                    print("rank {kill_rank} dying mid-run", flush=True)
+                    os._exit(17)
+                print(f"survivor {{multihost.process_index()}} torn down",
+                      flush=True)
+                os._exit(0)
+            return out
+
+    run_checkpointed(model, space, mgr, steps=10, every=2,
+                     executor=CrashingExecutor(ex))
+    raise AssertionError("phase 1 must die inside the crash chunk")
+
+# ---- phase 2: a fresh cluster resumes the SAME checkpoint directory ----
+committed = mgr.steps()
+assert committed == [0, 2, 4], committed  # step 6 died before commit
+out, step, report = run_checkpointed(model, space, mgr, steps=10, every=2,
+                                     executor=ex)
+assert step == 10, step
+full = gather_to_host(out.values["value"])
+
+# ground truth: the SAME run uninterrupted (chunked identically), fresh
+ex_ref = ShardMapExecutor(mesh)
+ref_space = CellularSpace.create(h, w, 1.0, dtype="float64")
+cur = ref_space
+for s in range(0, 10, 2):
+    cur, _ = model.execute(cur, ex_ref, steps=2, check_conservation=False)
+ref_full = gather_to_host(cur.values["value"])
+np.testing.assert_array_equal(full, ref_full)  # resume is BITWISE exact
+
+multihost.sync("after-resume")
+if multihost.is_master():
+    err = abs(float(full.sum()) - float(h * w))
+    assert err < 1e-9, err
+    print(f"MASTER ok: procs={{N}} resumed_from={{committed[-1]}} "
+          f"final_step={{step}} conservation_err={{err:.3e}} "
+          f"bitwise_resume=ok", flush=True)
+else:
+    print(f"worker {{multihost.process_index()}} done", flush=True)
+"""
+
+
+def _launch_workers(codes: list, timeout: int, devices_per_proc: int = 4):
+    """Spawn one subprocess per code string (virtual-CPU jax rig); return
+    [(rc, stdout, stderr), ...] in order."""
+    procs = []
+    for code in codes:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                            f"{devices_per_proc}")
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    return outs
+
+
+def dryrun_supervised_kill(nprocs: int = 4, kill_rank: int = 2,
+                           port: Optional[int] = None,
+                           timeout: int = 300) -> str:
+    """Failure injection across REAL process boundaries (round-4 VERDICT
+    task 7): an ``nprocs``-process jax.distributed cluster runs a
+    supervised, sharded-checkpointed simulation; rank ``kill_rank`` dies
+    hard mid-run AFTER computing steps past the last durable checkpoint
+    (that work is genuinely lost); then a fresh cluster resumes the same
+    checkpoint directory via ``run_checkpointed`` and must complete with
+    BITWISE-identical state to an uninterrupted run — the full
+    resilience story where ranks actually die, not just clean-path
+    save/restore. Returns the phase-2 master's report line."""
+    import tempfile
+
+    if nprocs < 2:
+        raise ValueError("dryrun_supervised_kill needs >= 2 processes")
+    if not 0 <= kill_rank < nprocs:
+        raise ValueError(f"kill_rank {kill_rank} outside 0..{nprocs - 1}")
+    if port is None:
+        port = 30100 + os.getpid() % 350
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    ckpt_dir = tempfile.mkdtemp(prefix="mmtpu_supkill_")
+    try:
+        def codes(phase, prt):
+            return [_SUPKILL_WORKER.format(
+                root=root, port=prt, pid=pid, nprocs=nprocs,
+                kill_rank=kill_rank, ckpt_dir=ckpt_dir, phase=phase)
+                for pid in range(nprocs)]
+
+        # phase 1: the crash run — victim must die 17, peers stop clean
+        outs = _launch_workers(codes(1, port), timeout, devices_per_proc=2)
+        for pid, (rc, out, err) in enumerate(outs):
+            want = 17 if pid == kill_rank else 0
+            if rc != want:
+                raise RuntimeError(
+                    f"phase-1 rank {pid}: rc={rc}, expected {want}:\n"
+                    f"{out[-2000:]}\n{err[-2000:]}")
+        if "dying mid-run" not in outs[kill_rank][1]:
+            raise RuntimeError(
+                f"victim never reached the crash point: "
+                f"{outs[kill_rank][1]!r}")
+
+        # phase 2: fresh cluster (new port), same checkpoint directory
+        outs = _launch_workers(codes(2, port + 1), timeout,
+                               devices_per_proc=2)
+        for pid, (rc, out, err) in enumerate(outs):
+            if rc != 0:
+                raise RuntimeError(
+                    f"phase-2 rank {pid} failed (rc={rc}):\n"
+                    f"{out[-2000:]}\n{err[-2000:]}")
+        master_out = outs[0][1]
+        if "MASTER ok" not in master_out:
+            raise RuntimeError(f"no master report in: {master_out!r}")
+        return master_out.strip().splitlines()[-1]
+    finally:
+        import shutil
+
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
 def dryrun_two_process(port: Optional[int] = None, timeout: int = 300) -> str:
     """Launch a real 2-process jax.distributed cluster on this host (4
     virtual CPU devices each → one 2x4 global mesh), run a sharded step

@@ -1,10 +1,15 @@
-from .metrics import marginal_runner_time, marginal_step_time
+from .metrics import (marginal_runner_time, marginal_runner_trials,
+                      marginal_step_time, marginal_step_trials,
+                      median_spread)
 from .roofline import chip_peaks, stencil_roofline
 from .tracing import Span, Tracer, get_tracer, set_tracer, trace_span
 
 __all__ = [
     "marginal_step_time",
+    "marginal_step_trials",
+    "median_spread",
     "marginal_runner_time",
+    "marginal_runner_trials",
     "chip_peaks",
     "stencil_roofline",
     "Span",

@@ -53,6 +53,82 @@ def marginal_step_time(step: Callable, values: Values, s1: int = 50,
     return (times[s2] - times[s1]) / (s2 - s1)
 
 
+def marginal_step_trials(step: Callable, values: Values, s1: int = 10,
+                         s2: int = 60, trials: int = 5,
+                         donate: bool = True) -> list[float]:
+    """``trials`` independent marginal per-step estimates (seconds).
+
+    The two scan lengths are timed back-to-back WITHIN each trial, so
+    chip-state drift on the shared tunnel chip hits both arms of one
+    marginal estimate together; the runners are built and warmed once
+    (one compile), then every trial is pure timing. Callers take the
+    MEDIAN and report the min/max spread — BASELINE.md's noise
+    discipline ("interleaved medians are not optional"), now applied to
+    the driver headline too (round-4 VERDICT weak #1)."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    runners = {}
+    for steps in (s1, s2):
+        def run_fn(v, _steps=steps):
+            def body(c, _):
+                return step(c), None
+            out, _ = jax.lax.scan(body, v, None, length=_steps)
+            return out, jnp.sum(
+                jax.tree.leaves(out)[0].astype(jnp.float32))
+        run = jax.jit(run_fn, donate_argnums=0 if donate else ())
+        fresh = jax.tree.map(jnp.copy, values)
+        _, s = run(fresh)
+        _ = float(s)  # warmup / compile
+        runners[steps] = run
+
+    out: list[float] = []
+    for _ in range(trials):
+        ts = {}
+        for steps in (s1, s2):
+            fresh = jax.tree.map(jnp.copy, values)
+            t0 = _time.perf_counter()
+            _, s = runners[steps](fresh)
+            _ = float(s)
+            ts[steps] = _time.perf_counter() - t0
+        out.append((ts[s2] - ts[s1]) / (s2 - s1))
+    return out
+
+
+def marginal_runner_trials(make_output: Callable[[int], object],
+                           s1: int = 10, s2: int = 40,
+                           trials: int = 3) -> list[float]:
+    """``trials`` marginal per-step estimates for an arbitrary runner
+    (``make_output(num_steps)`` must block until the work is done): the
+    runner-shaped counterpart of ``marginal_step_trials``, with the same
+    back-to-back-within-a-trial discipline. Call ``make_output(s1)``
+    once yourself first if warmup/compile must not pollute trial 1 —
+    this function times every call it makes."""
+    import time as _time
+
+    out: list[float] = []
+    for _ in range(trials):
+        ts = {}
+        for steps in (s1, s2):
+            t0 = _time.perf_counter()
+            make_output(steps)
+            ts[steps] = _time.perf_counter() - t0
+        out.append((ts[s2] - ts[s1]) / (s2 - s1))
+    return out
+
+
+def median_spread(samples: list[float]) -> dict:
+    """{value: median, spread_lo: min, spread_hi: max} of the samples —
+    the shape BENCH/ladder rows report so successive rounds don't read
+    tunnel noise as regressions."""
+    import statistics
+
+    return {"value": statistics.median(samples),
+            "spread_lo": min(samples), "spread_hi": max(samples)}
+
+
 def marginal_runner_time(make_output: Callable[[int], object],
                          s1: int = 10, s2: int = 50,
                          reps: int = 2) -> float:

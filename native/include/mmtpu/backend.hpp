@@ -18,6 +18,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstring>
 #include <deque>
 #include <memory>
 #include <mutex>
@@ -25,12 +26,19 @@
 #include <string>
 #include <vector>
 
+#include "abstraction.hpp"
+
 namespace mmtpu {
 
+// Wire message: TYPED bytes, like the reference's Send<T>/Receive<T>
+// (MPIImpl.hpp:30-38) — the dtype tag travels with the payload, so a
+// sender/receiver type mismatch is a diagnosable error instead of
+// silent reinterpretation.
 struct Message {
   int src = 0;
   int tag = 0;
-  std::vector<double> payload;
+  DataType dtype = DataType::kFloat64;
+  std::vector<uint8_t> bytes;
 };
 
 // A blocking receive gave up waiting: the failure-DETECTION signal the
@@ -56,7 +64,7 @@ class Mailbox {
   // Blocking receive of the first message matching (src, tag).
   // timeout_ms == 0 waits forever (the reference's MPI_Recv semantics);
   // otherwise throws RecvTimeout once the deadline passes.
-  std::vector<double> recv(int src, int tag, long timeout_ms = 0) {
+  Message recv(int src, int tag, long timeout_ms = 0) {
     std::unique_lock<std::mutex> lk(mu_);
     const auto deadline = std::chrono::steady_clock::now() +
                           std::chrono::milliseconds(timeout_ms);
@@ -64,7 +72,7 @@ class Mailbox {
     for (;;) {
       for (auto it = box_.begin(); it != box_.end(); ++it) {
         if (it->src == src && it->tag == tag) {
-          auto out = std::move(it->payload);
+          Message out = std::move(*it);
           box_.erase(it);
           return out;
         }
@@ -108,15 +116,41 @@ class ThreadComm {
   long recv_timeout_ms() const { return recv_timeout_ms_; }
 
   // Blocking typed send/recv (the reference's Send<T>/Receive<T> wrappers,
-  // MPIImpl.hpp:30-38, fixed to actually be used by the runtime).
-  void send(int src, int dst, int tag, std::vector<double> payload) {
+  // MPIImpl.hpp:30-38, fixed to actually be used by the runtime): any
+  // scalar in the L0 tag table rides the wire with its tag; a received
+  // message whose tag differs from the requested T is an error, not a
+  // reinterpret_cast.
+  template <typename T>
+  void send_t(int src, int dst, int tag, const std::vector<T>& payload) {
     if (dst < 0 || dst >= size()) throw std::out_of_range("bad dst rank");
-    boxes_[dst]->put(Message{src, tag, std::move(payload)});
+    Message m{src, tag, data_type_of<T>(), {}};
+    m.bytes.resize(payload.size() * sizeof(T));
+    std::memcpy(m.bytes.data(), payload.data(), m.bytes.size());
+    boxes_[dst]->put(std::move(m));
+  }
+
+  template <typename T>
+  std::vector<T> recv_t(int src, int dst, int tag) {
+    if (dst < 0 || dst >= size()) throw std::out_of_range("bad dst rank");
+    Message m = boxes_[dst]->recv(src, tag, recv_timeout_ms_);
+    if (m.dtype != data_type_of<T>())
+      throw UnsupportedDataTypeError(
+          "typed recv mismatch: message (src=" + std::to_string(src) +
+          ", tag=" + std::to_string(tag) + ") carries dtype tag " +
+          std::to_string(static_cast<int>(m.dtype)) + ", requested " +
+          std::to_string(static_cast<int>(data_type_of<T>())));
+    std::vector<T> out(m.bytes.size() / sizeof(T));
+    std::memcpy(out.data(), m.bytes.data(), m.bytes.size());
+    return out;
+  }
+
+  // f64 convenience forms (the pre-typed ABI surface; selftests use them).
+  void send(int src, int dst, int tag, std::vector<double> payload) {
+    send_t<double>(src, dst, tag, payload);
   }
 
   std::vector<double> recv(int src, int dst, int tag) {
-    if (dst < 0 || dst >= size()) throw std::out_of_range("bad dst rank");
-    return boxes_[dst]->recv(src, tag, recv_timeout_ms_);
+    return recv_t<double>(src, dst, tag);
   }
 
  private:

@@ -3,11 +3,16 @@
 // Rebuild of the reference's CellularSpace<T>/CellularSpaceRectangular<T>
 // (/root/reference/src/CellularSpace.hpp:11-80, CellularSpaceRectangular
 // .hpp:9-32). The reference stores a fixed-size array of Cell structs per
-// partition; here the grid is named channels of contiguous doubles
+// partition; here the grid is named channels of contiguous scalars
 // (row-major, matching memoria[x*width + y]) with partition geometry as
 // data — local extent + global origin/bounds, the typed realization of the
 // wire descriptor "x_init|y_init:height|width" (Model.hpp:67-76) that the
-// dead Scatter (CellularSpace.hpp:36-79) intended.
+// dead Scatter (CellularSpace.hpp:36-79) intended. The channel store is
+// TEMPLATED over the L0 scalar (``BasicCellularSpace<T>`` — the
+// reference's seam carries ten types, Abstraction.hpp:23-76; this engine
+// instantiates f32 and f64, ``DataTypeOf<T>`` pins the tag); reductions
+// accumulate in double regardless of storage, matching the Python side's
+// f64 conservation totals.
 #pragma once
 
 #include <cstdint>
@@ -16,6 +21,7 @@
 #include <string>
 #include <vector>
 
+#include "abstraction.hpp"
 #include "cell.hpp"
 
 namespace mmtpu {
@@ -60,11 +66,13 @@ inline std::vector<Partition> block_partitions(int dim_x, int dim_y, int lines,
   return parts;
 }
 
-class CellularSpace {
+template <typename T>
+class BasicCellularSpace {
  public:
-  CellularSpace(int dim_x, int dim_y, double init = 1.0,
-                std::vector<std::string> attrs = {"value"}, int x_init = 0,
-                int y_init = 0, int global_dim_x = -1, int global_dim_y = -1)
+  BasicCellularSpace(int dim_x, int dim_y, double init = 1.0,
+                     std::vector<std::string> attrs = {"value"},
+                     int x_init = 0, int y_init = 0, int global_dim_x = -1,
+                     int global_dim_y = -1)
       : dim_x_(dim_x),
         dim_y_(dim_y),
         x_init_(x_init),
@@ -72,8 +80,11 @@ class CellularSpace {
         global_dim_x_(global_dim_x < 0 ? dim_x : global_dim_x),
         global_dim_y_(global_dim_y < 0 ? dim_y : global_dim_y) {
     for (const auto& a : attrs)
-      values_[a].assign(static_cast<size_t>(dim_x) * dim_y, init);
+      values_[a].assign(static_cast<size_t>(dim_x) * dim_y,
+                        static_cast<T>(init));
   }
+
+  static constexpr DataType dtype() { return data_type_of<T>(); }
 
   int dim_x() const { return dim_x_; }
   int dim_y() const { return dim_y_; }
@@ -89,14 +100,14 @@ class CellularSpace {
     return out;
   }
 
-  std::vector<double>& channel(const std::string& attr) {
+  std::vector<T>& channel(const std::string& attr) {
     auto it = values_.find(attr);
     if (it == values_.end())
       throw std::out_of_range("no attribute channel '" + attr + "'");
     return it->second;
   }
-  const std::vector<double>& channel(const std::string& attr) const {
-    return const_cast<CellularSpace*>(this)->channel(attr);
+  const std::vector<T>& channel(const std::string& attr) const {
+    return const_cast<BasicCellularSpace*>(this)->channel(attr);
   }
 
   // Global → local flat index with bounds check (no silent wrapping — the
@@ -110,10 +121,10 @@ class CellularSpace {
   }
 
   double get(int x, int y, const std::string& attr = "value") const {
-    return channel(attr)[local_index(x, y)];
+    return static_cast<double>(channel(attr)[local_index(x, y)]);
   }
   void set(int x, int y, double v, const std::string& attr = "value") {
-    channel(attr)[local_index(x, y)] = v;
+    channel(attr)[local_index(x, y)] = static_cast<T>(v);
   }
 
   Cell get_cell(int x, int y, const std::string& attr = "value") const {
@@ -123,18 +134,18 @@ class CellularSpace {
   }
 
   // Conservation quantity (the reference's per-rank reduction,
-  // Model.hpp:238-240).
+  // Model.hpp:238-240); accumulated in f64 whatever the storage type.
   double total(const std::string& attr = "value") const {
     double s = 0.0;
-    for (double v : channel(attr)) s += v;
+    for (T v : channel(attr)) s += static_cast<double>(v);
     return s;
   }
 
   // Extract one partition as its own space (the dead Scatter's worker
   // branch, CellularSpace.hpp:61-78, as a value operation).
-  CellularSpace slice(const Partition& p) const {
-    CellularSpace out(p.height, p.width, 0.0, attribute_names(), p.x_init,
-                      p.y_init, global_dim_x_, global_dim_y_);
+  BasicCellularSpace slice(const Partition& p) const {
+    BasicCellularSpace out(p.height, p.width, 0.0, attribute_names(),
+                           p.x_init, p.y_init, global_dim_x_, global_dim_y_);
     for (const auto& [attr, src] : values_) {
       auto& dst = out.channel(attr);
       for (int i = 0; i < p.height; ++i)
@@ -146,7 +157,7 @@ class CellularSpace {
   }
 
   // Write a partition's channels back into this (global) space.
-  void merge(const CellularSpace& part) {
+  void merge(const BasicCellularSpace& part) {
     for (const auto& [attr, src] : part.values_) {
       auto& dst = channel(attr);
       for (int i = 0; i < part.dim_x_; ++i)
@@ -158,7 +169,13 @@ class CellularSpace {
 
  private:
   int dim_x_, dim_y_, x_init_, y_init_, global_dim_x_, global_dim_y_;
-  std::map<std::string, std::vector<double>> values_;
+  std::map<std::string, std::vector<T>> values_;
 };
+
+// The f64 engine (the reference's `double` default, Defines.hpp:6) keeps
+// the historical unqualified name; f32 is the second first-class
+// instantiation (golden-tested against the f32 JAX path).
+using CellularSpace = BasicCellularSpace<double>;
+using CellularSpaceF32 = BasicCellularSpace<float>;
 
 }  // namespace mmtpu

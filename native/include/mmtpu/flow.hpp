@@ -7,7 +7,12 @@
 // (mpi_model_tpu/ops): a flow yields an outflow field; transport() sheds
 // it and deposits outflow/neighbor_count on each in-bounds Moore neighbor
 // — mass-conserving by construction, with the reference's snapshot
-// (frozen_source_value) semantics available for bit-parity.
+// (frozen_source_value) semantics available for bit-parity. TEMPLATED
+// over the L0 scalar (``BasicFlow<T>`` over ``BasicCellularSpace<T>``):
+// field math runs in the storage type — the engine's f32 instantiation
+// is a true f32 engine, not f64 math over f32 views — while per-flow
+// amount memos and reductions accumulate in double (the Python side's
+// f64 totals).
 #pragma once
 
 #include <cmath>
@@ -23,8 +28,10 @@ namespace mmtpu {
 
 // Per-cell neighbor counts of a partition, evaluated against the global
 // bounds (the vectorized SetNeighbor; see Python neighbor_count_grid).
-inline std::vector<double> neighbor_counts(const CellularSpace& cs) {
-  std::vector<double> counts(cs.num_cells(), 0.0);
+// Counts are <= 8, exact in every float type.
+template <typename T>
+inline std::vector<T> neighbor_counts(const BasicCellularSpace<T>& cs) {
+  std::vector<T> counts(cs.num_cells(), T(0));
   for (int i = 0; i < cs.dim_x(); ++i) {
     for (int j = 0; j < cs.dim_y(); ++j) {
       int gx = cs.x_init() + i, gy = cs.y_init() + j;
@@ -35,17 +42,18 @@ inline std::vector<double> neighbor_counts(const CellularSpace& cs) {
             ny < cs.global_dim_y())
           ++c;
       }
-      counts[static_cast<size_t>(i) * cs.dim_y() + j] = c;
+      counts[static_cast<size_t>(i) * cs.dim_y() + j] = static_cast<T>(c);
     }
   }
   return counts;
 }
 
-class Flow {
+template <typename T>
+class BasicFlow {
  public:
-  explicit Flow(std::string attr = "value", double rate = 0.0)
+  explicit BasicFlow(std::string attr = "value", double rate = 0.0)
       : attr_(std::move(attr)), flow_rate_(rate) {}
-  virtual ~Flow() = default;
+  virtual ~BasicFlow() = default;
 
   const std::string& attr() const { return attr_; }
   double flow_rate() const { return flow_rate_; }
@@ -55,12 +63,13 @@ class Flow {
   void set_last_execute(double v) { last_execute_ = v; }
 
   // Fill `out` (same layout as the space's channels) with this flow's
-  // outflow for the current values; returns the amount moved. const —
-  // in threaded runs every rank invokes the SAME shared Flow object
-  // concurrently on its partition, so the op must not touch shared
-  // state (a TSan-caught race when the memo write lived here).
-  virtual double add_outflow(const CellularSpace& cs,
-                             std::vector<double>& out) const = 0;
+  // outflow for the current values; returns the amount moved (f64
+  // accumulation). const — in threaded runs every rank invokes the SAME
+  // shared Flow object concurrently on its partition, so the op must not
+  // touch shared state (a TSan-caught race when the memo write lived
+  // here).
+  virtual double add_outflow(const BasicCellularSpace<T>& cs,
+                             std::vector<T>& out) const = 0;
 
  protected:
   std::string attr_;
@@ -71,27 +80,29 @@ class Flow {
 };
 
 // Single-source flow; the reference's live case (Main.cpp:32-33).
-class PointFlow : public Flow {
+template <typename T>
+class BasicPointFlow : public BasicFlow<T> {
  public:
-  PointFlow(int x, int y, double rate, std::string attr = "value",
-            std::optional<double> frozen = std::nullopt)
-      : Flow(std::move(attr), rate), x_(x), y_(y), frozen_(frozen) {}
+  BasicPointFlow(int x, int y, double rate, std::string attr = "value",
+                 std::optional<double> frozen = std::nullopt)
+      : BasicFlow<T>(std::move(attr), rate), x_(x), y_(y), frozen_(frozen) {}
 
   // Reference-style construction from a Cell snapshots its value
   // (Flow.hpp:22-28).
-  PointFlow(const Cell& cell, double rate, std::string attr = "value")
-      : PointFlow(cell.x, cell.y, rate, std::move(attr),
-                  cell.attribute.value) {}
+  BasicPointFlow(const Cell& cell, double rate, std::string attr = "value")
+      : BasicPointFlow(cell.x, cell.y, rate, std::move(attr),
+                       cell.attribute.value) {}
 
-  double add_outflow(const CellularSpace& cs,
-                     std::vector<double>& out) const override {
+  double add_outflow(const BasicCellularSpace<T>& cs,
+                     std::vector<T>& out) const override {
     Partition p{cs.x_init(), cs.y_init(), cs.dim_x(), cs.dim_y(), 0};
     if (!p.contains(x_, y_)) return 0.0;  // owner test, Model.hpp:176
     size_t idx = cs.local_index(x_, y_);
-    double v = frozen_ ? *frozen_ : cs.channel(attr_)[idx];
-    double amount = flow_rate_ * v;
+    T v = frozen_ ? static_cast<T>(*frozen_)
+                  : cs.channel(this->attr_)[idx];
+    T amount = static_cast<T>(this->flow_rate_) * v;
     out[idx] += amount;
-    return amount;
+    return static_cast<double>(amount);
   }
 
   int x() const { return x_; }
@@ -100,51 +111,53 @@ class PointFlow : public Flow {
  private:
   int x_, y_;
   std::optional<double> frozen_;
-  size_t local_index(const CellularSpace& cs) const {
-    return cs.local_index(x_, y_);
-  }
 };
 
 // Exponencial: execute() = flow_rate * source value (Exponencial.hpp:14-16).
-class Exponencial : public PointFlow {
+template <typename T>
+class BasicExponencial : public BasicPointFlow<T> {
  public:
-  using PointFlow::PointFlow;
+  using BasicPointFlow<T>::BasicPointFlow;
 };
 
 // Dense flow: every cell sheds rate * value (benchmark ladder op).
-class Diffusion : public Flow {
+template <typename T>
+class BasicDiffusion : public BasicFlow<T> {
  public:
-  explicit Diffusion(double rate, std::string attr = "value")
-      : Flow(std::move(attr), rate) {}
+  explicit BasicDiffusion(double rate, std::string attr = "value")
+      : BasicFlow<T>(std::move(attr), rate) {}
 
-  double add_outflow(const CellularSpace& cs,
-                     std::vector<double>& out) const override {
-    const auto& v = cs.channel(attr_);
+  double add_outflow(const BasicCellularSpace<T>& cs,
+                     std::vector<T>& out) const override {
+    const auto& v = cs.channel(this->attr_);
+    const T rate = static_cast<T>(this->flow_rate_);
     double total = 0.0;
     for (size_t i = 0; i < v.size(); ++i) {
-      double o = flow_rate_ * v[i];
+      T o = rate * v[i];
       out[i] += o;
-      total += o;
+      total += static_cast<double>(o);
     }
     return total;
   }
 };
 
 // Outflow of `attr` modulated by another channel (coupled flows).
-class Coupled : public Flow {
+template <typename T>
+class BasicCoupled : public BasicFlow<T> {
  public:
-  Coupled(double rate, std::string attr, std::string modulator)
-      : Flow(std::move(attr), rate), modulator_(std::move(modulator)) {}
+  BasicCoupled(double rate, std::string attr, std::string modulator)
+      : BasicFlow<T>(std::move(attr), rate), modulator_(std::move(modulator)) {}
 
-  double add_outflow(const CellularSpace& cs,
-                     std::vector<double>& out) const override {
-    const auto& v = cs.channel(attr_);
+  double add_outflow(const BasicCellularSpace<T>& cs,
+                     std::vector<T>& out) const override {
+    const auto& v = cs.channel(this->attr_);
     const auto& m = cs.channel(modulator_);
+    const T rate = static_cast<T>(this->flow_rate_);
     double total = 0.0;
     for (size_t i = 0; i < v.size(); ++i) {
-      double o = flow_rate_ * v[i] * m[i];
+      T o = rate * v[i] * m[i];
       out[i] += o;
-      total += o;
+      total += static_cast<double>(o);
     }
     return total;
   }
@@ -152,6 +165,13 @@ class Coupled : public Flow {
  private:
   std::string modulator_;
 };
+
+// f64 aliases: the engine's historical unqualified names.
+using Flow = BasicFlow<double>;
+using PointFlow = BasicPointFlow<double>;
+using Exponencial = BasicExponencial<double>;
+using Diffusion = BasicDiffusion<double>;
+using Coupled = BasicCoupled<double>;
 
 // --- transport: the mass-conserving redistribution ----------------------
 //
@@ -164,11 +184,12 @@ class Coupled : public Flow {
 // delivering them, and total inflow == total outflow.
 
 // [h+2, w+2] row-major padded buffer holding share in its interior.
-inline std::vector<double> padded_share(const CellularSpace& cs,
-                                        const std::vector<double>& outflow,
-                                        const std::vector<double>& counts) {
+template <typename T>
+inline std::vector<T> padded_share(const BasicCellularSpace<T>& cs,
+                                   const std::vector<T>& outflow,
+                                   const std::vector<T>& counts) {
   const int h = cs.dim_x(), w = cs.dim_y();
-  std::vector<double> padded(static_cast<size_t>(h + 2) * (w + 2), 0.0);
+  std::vector<T> padded(static_cast<size_t>(h + 2) * (w + 2), T(0));
   for (int i = 0; i < h; ++i)
     for (int j = 0; j < w; ++j) {
       size_t idx = static_cast<size_t>(i) * w + j;
@@ -179,15 +200,17 @@ inline std::vector<double> padded_share(const CellularSpace& cs,
 }
 
 // values += gather(padded) - outflow.
-inline void apply_transport(CellularSpace& cs, const std::string& attr,
-                            const std::vector<double>& outflow,
-                            const std::vector<double>& padded) {
+template <typename T>
+inline void apply_transport(BasicCellularSpace<T>& cs,
+                            const std::string& attr,
+                            const std::vector<T>& outflow,
+                            const std::vector<T>& padded) {
   auto& v = cs.channel(attr);
   const int h = cs.dim_x(), w = cs.dim_y();
   const size_t pw = static_cast<size_t>(w) + 2;
   for (int i = 0; i < h; ++i) {
     for (int j = 0; j < w; ++j) {
-      double inflow = 0.0;
+      T inflow = T(0);
       for (const auto& [dx, dy] : moore_offsets())
         inflow += padded[static_cast<size_t>(i + 1 + dx) * pw + (j + 1 + dy)];
       size_t idx = static_cast<size_t>(i) * w + j;
@@ -197,9 +220,10 @@ inline void apply_transport(CellularSpace& cs, const std::string& attr,
 }
 
 // Serial single-partition step (ghost ring all zeros — non-periodic grid).
-inline void transport(CellularSpace& cs, const std::string& attr,
-                      const std::vector<double>& outflow,
-                      const std::vector<double>& counts) {
+template <typename T>
+inline void transport(BasicCellularSpace<T>& cs, const std::string& attr,
+                      const std::vector<T>& outflow,
+                      const std::vector<T>& counts) {
   apply_transport(cs, attr, outflow, padded_share(cs, outflow, counts));
 }
 

@@ -43,14 +43,21 @@ class ConservationError : public std::runtime_error {
   explicit ConservationError(const std::string& w) : std::runtime_error(w) {}
 };
 
-using FlowPtr = std::shared_ptr<Flow>;
+template <typename T>
+using BasicFlowPtr = std::shared_ptr<BasicFlow<T>>;
+using FlowPtr = BasicFlowPtr<double>;
 
-class Model {
+template <typename T>
+class BasicModel {
  public:
-  Model(FlowPtr flow, double time = 1.0, double time_step = 1.0)
-      : Model(std::vector<FlowPtr>{std::move(flow)}, time, time_step) {}
+  using FlowP = BasicFlowPtr<T>;
+  using Space = BasicCellularSpace<T>;
 
-  Model(std::vector<FlowPtr> flows, double time = 1.0, double time_step = 1.0)
+  BasicModel(FlowP flow, double time = 1.0, double time_step = 1.0)
+      : BasicModel(std::vector<FlowP>{std::move(flow)}, time, time_step) {}
+
+  BasicModel(std::vector<FlowP> flows, double time = 1.0,
+             double time_step = 1.0)
       : flows_(std::move(flows)), time_(time), time_step_(time_step) {}
 
   int num_steps() const {
@@ -58,7 +65,7 @@ class Model {
     return n > 0 ? n : 1;
   }
 
-  const std::vector<FlowPtr>& flows() const { return flows_; }
+  const std::vector<FlowP>& flows() const { return flows_; }
 
   // One step on one partition, ghost ring provided by `fill_ghosts`
   // (serial: leave zeros). Outflows are computed per attribute from
@@ -67,16 +74,16 @@ class Model {
   // Flow::last_execute memo, which the orchestrator combines after the
   // step (workers must not write shared Flow state; TSan-verified).
   void step_partition(
-      CellularSpace& cs, const std::vector<double>& counts,
-      const std::function<void(const std::string&, std::vector<double>&)>&
+      Space& cs, const std::vector<T>& counts,
+      const std::function<void(const std::string&, std::vector<T>&)>&
           fill_ghosts = {},
       std::vector<double>* amounts = nullptr) const {
     // group outflows by attribute
-    std::map<std::string, std::vector<double>> outflows;
+    std::map<std::string, std::vector<T>> outflows;
     for (size_t fi = 0; fi < flows_.size(); ++fi) {
       const auto& f = flows_[fi];
       auto& of = outflows[f->attr()];
-      if (of.empty()) of.assign(cs.num_cells(), 0.0);
+      if (of.empty()) of.assign(cs.num_cells(), T(0));
       double amt = f->add_outflow(cs, of);
       if (amounts) (*amounts)[fi] = amt;
     }
@@ -89,7 +96,7 @@ class Model {
 
   // Serial execution (the reference's 'missing implement' stub,
   // Model.hpp:47-51, implemented).
-  Report execute(CellularSpace& cs, int steps = -1,
+  Report execute(Space& cs, int steps = -1,
                  bool check_conservation = true,
                  double tolerance = 1e-3) const {
     Report rep;
@@ -112,7 +119,7 @@ class Model {
   // halo_timeout_ms bounds every halo receive (failure detection: a dead
   // rank raises RecvTimeout instead of hanging the job); 0 restores the
   // reference's unbounded MPI_Recv semantics.
-  Report execute_threaded(CellularSpace& cs, int lines, int columns,
+  Report execute_threaded(Space& cs, int lines, int columns,
                           int steps = -1, bool check_conservation = true,
                           double tolerance = 1e-3,
                           long halo_timeout_ms = 60000) const {
@@ -124,7 +131,7 @@ class Model {
 
     auto parts = block_partitions(cs.dim_x(), cs.dim_y(), lines, columns);
     ThreadComm comm(n, halo_timeout_ms);
-    std::vector<CellularSpace> locals;
+    std::vector<Space> locals;
     locals.reserve(n);
     for (const auto& p : parts) locals.push_back(cs.slice(p));
 
@@ -163,7 +170,7 @@ class Model {
   // Halo tags: phase1 (columns along y), phase2 (rows along x).
   enum Tag : int { kLeft = 1, kRight = 2, kUp = 3, kDown = 4, kSum = 99 };
 
-  void worker(CellularSpace& local, ThreadComm& comm, int rank, int lines,
+  void worker(Space& local, ThreadComm& comm, int rank, int lines,
               int columns, int nsteps, std::vector<double>& partials,
               std::vector<double>& my_amounts) const {
     const int pi = rank / columns, pj = rank % columns;
@@ -171,43 +178,43 @@ class Model {
     const size_t pw = static_cast<size_t>(w) + 2;
     auto counts = neighbor_counts(local);
 
-    auto fill = [&](const std::string& attr, std::vector<double>& padded) {
+    auto fill = [&](const std::string& attr, std::vector<T>& padded) {
       (void)attr;
       // --- phase 1: exchange edge COLUMNS with left/right ranks ---------
       auto col = [&](int j) {
-        std::vector<double> c(h);
+        std::vector<T> c(h);
         for (int i = 0; i < h; ++i)
           c[i] = padded[static_cast<size_t>(i + 1) * pw + j];
         return c;
       };
-      if (pj > 0) comm.send(rank, rank - 1, kRight, col(1));
-      if (pj < columns - 1) comm.send(rank, rank + 1, kLeft, col(w));
+      if (pj > 0) comm.send_t<T>(rank, rank - 1, kRight, col(1));
+      if (pj < columns - 1) comm.send_t<T>(rank, rank + 1, kLeft, col(w));
       if (pj < columns - 1) {
-        auto c = comm.recv(rank + 1, rank, kRight);  // right nbr's left col
+        auto c = comm.recv_t<T>(rank + 1, rank, kRight);  // right nbr's left col
         for (int i = 0; i < h; ++i)
           padded[static_cast<size_t>(i + 1) * pw + (w + 1)] = c[i];
       }
       if (pj > 0) {
-        auto c = comm.recv(rank - 1, rank, kLeft);  // left nbr's right col
+        auto c = comm.recv_t<T>(rank - 1, rank, kLeft);  // left nbr's right col
         for (int i = 0; i < h; ++i)
           padded[static_cast<size_t>(i + 1) * pw + 0] = c[i];
       }
       // --- phase 2: exchange AUGMENTED rows (corners ride along) --------
       auto row = [&](int i) {
-        std::vector<double> r(pw);
+        std::vector<T> r(pw);
         for (size_t j = 0; j < pw; ++j)
           r[j] = padded[static_cast<size_t>(i) * pw + j];
         return r;
       };
-      if (pi > 0) comm.send(rank, rank - columns, kDown, row(1));
-      if (pi < lines - 1) comm.send(rank, rank + columns, kUp, row(h));
+      if (pi > 0) comm.send_t<T>(rank, rank - columns, kDown, row(1));
+      if (pi < lines - 1) comm.send_t<T>(rank, rank + columns, kUp, row(h));
       if (pi < lines - 1) {
-        auto rrow = comm.recv(rank + columns, rank, kDown);
+        auto rrow = comm.recv_t<T>(rank + columns, rank, kDown);
         for (size_t j = 0; j < pw; ++j)
           padded[static_cast<size_t>(h + 1) * pw + j] = rrow[j];
       }
       if (pi > 0) {
-        auto rrow = comm.recv(rank - columns, rank, kUp);
+        auto rrow = comm.recv_t<T>(rank - columns, rank, kUp);
         for (size_t j = 0; j < pw; ++j) padded[j] = rrow[j];
       }
     };
@@ -219,13 +226,13 @@ class Model {
     partials[rank] = total_all(local);
   }
 
-  double total_all(const CellularSpace& cs) const {
+  double total_all(const Space& cs) const {
     double t = 0.0;
     for (const auto& a : cs.attribute_names()) t += cs.total(a);
     return t;
   }
 
-  void finish_report(Report& rep, const CellularSpace& cs,
+  void finish_report(Report& rep, const Space& cs,
                      bool check_conservation, double tolerance) const {
     (void)cs;
     rep.conservation_error = std::fabs(rep.final_total - rep.initial_total);
@@ -236,8 +243,13 @@ class Model {
                               std::to_string(tolerance));
   }
 
-  std::vector<FlowPtr> flows_;
+  std::vector<FlowP> flows_;
   double time_, time_step_;
 };
+
+// The f64 engine keeps the historical unqualified name; f32 is the
+// second first-class instantiation (golden-tested against f32 JAX).
+using Model = BasicModel<double>;
+using ModelF32 = BasicModel<float>;
 
 }  // namespace mmtpu

@@ -3,12 +3,16 @@
 // The Python side binds this with ctypes (mpi_model_tpu/native.py) — the
 // pybind11-free Python↔C++ boundary. Kept coarse: one call runs a whole
 // simulation (SURVEY §7 'keep the boundary coarse or throughput dies').
-// Channels are exposed as raw double* views over the struct-of-arrays
-// storage so NumPy can wrap them without copies.
+// A space carries its L0 dtype tag (f32 or f64 engine instantiation —
+// the reference's Abstraction.hpp seam realized end-to-end); channels
+// are exposed as raw typed views over the struct-of-arrays storage so
+// NumPy can wrap them without copies, and a view requested at the wrong
+// type is an error, not a reinterpretation.
 
 #include <cstring>
 #include <memory>
 #include <string>
+#include <variant>
 #include <vector>
 
 #include "mmtpu/abstraction.hpp"
@@ -25,10 +29,8 @@ thread_local std::string g_last_error;
 void set_error(const std::string& e) { g_last_error = e; }
 }  // namespace
 
-extern "C" {
-
 struct mmtpu_space {
-  CellularSpace cs;
+  std::variant<CellularSpace, CellularSpaceF32> cs;
 };
 
 typedef struct {
@@ -41,9 +43,53 @@ typedef struct {
   double frozen;
 } mmtpu_flow_spec;
 
+namespace {
+
+template <typename T>
+std::vector<BasicFlowPtr<T>> build_flows(const mmtpu_flow_spec* specs,
+                                         int n_flows) {
+  std::vector<BasicFlowPtr<T>> flows;
+  for (int i = 0; i < n_flows; ++i) {
+    const auto& fs = specs[i];
+    std::string attr = fs.attr ? fs.attr : "value";
+    switch (fs.type) {
+      case 0:
+        flows.push_back(std::make_shared<BasicPointFlow<T>>(
+            fs.x, fs.y, fs.rate, attr,
+            fs.has_frozen ? std::optional<double>(fs.frozen)
+                          : std::nullopt));
+        break;
+      case 1:
+        flows.push_back(std::make_shared<BasicDiffusion<T>>(fs.rate, attr));
+        break;
+      case 2:
+        flows.push_back(std::make_shared<BasicCoupled<T>>(
+            fs.rate, attr, fs.modulator ? fs.modulator : "value"));
+        break;
+      default:
+        throw std::runtime_error("unknown flow type " +
+                                 std::to_string(fs.type));
+    }
+  }
+  return flows;
+}
+
+template <typename T>
+Report run_typed(BasicCellularSpace<T>& cs, const mmtpu_flow_spec* specs,
+                 int n_flows, int steps, int lines, int columns) {
+  BasicModel<T> model(build_flows<T>(specs, n_flows));
+  if (lines * columns <= 1) return model.execute(cs, steps, /*check=*/false);
+  return model.execute_threaded(cs, lines, columns, steps, /*check=*/false);
+}
+
+}  // namespace
+
+extern "C" {
+
 const char* mmtpu_last_error() { return g_last_error.c_str(); }
 
-int mmtpu_abi_version() { return 1; }
+// v2: typed spaces (create_typed/dtype/channel_f32) + typed wire messages.
+int mmtpu_abi_version() { return 2; }
 
 // Failure-detection self-test: a 2-rank comm where rank 1 never sends —
 // the bounded recv must surface RecvTimeout (the hang the reference's
@@ -63,32 +109,104 @@ int mmtpu_selftest_recv_timeout(int timeout_ms) {
   }
 }
 
-// ABI pin for the dtype tags shared with mpi_model_tpu/abstraction.py.
+// Typed-wire self-test: an f32 payload received as f64 must raise the
+// dtype-mismatch error (1 = correctly rejected; 0 = silently accepted —
+// a bug; -1 = unexpected error).
+int mmtpu_selftest_typed_wire() {
+  try {
+    ThreadComm comm(2, 1000);
+    comm.send_t<float>(0, 1, 3, std::vector<float>{1.f, 2.f});
+    try {
+      (void)comm.recv_t<double>(0, 1, 3);
+      return 0;
+    } catch (const UnsupportedDataTypeError&) {
+    }
+    // and the matching-type path round-trips
+    comm.send_t<float>(0, 1, 4, std::vector<float>{3.f});
+    auto v = comm.recv_t<float>(0, 1, 4);
+    return (v.size() == 1 && v[0] == 3.f) ? 1 : 0;
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
+// ABI pins for the dtype tags shared with mpi_model_tpu/abstraction.py.
 int mmtpu_dtype_tag_float64() {
   return static_cast<int>(data_type_of<double>());
 }
+int mmtpu_dtype_tag_float32() {
+  return static_cast<int>(data_type_of<float>());
+}
 
-mmtpu_space* mmtpu_space_create(int dim_x, int dim_y, double init,
-                                const char** attrs, int n_attrs) {
+static mmtpu_space* create_space(int dim_x, int dim_y, double init,
+                                 const char** attrs, int n_attrs,
+                                 int dtype_tag) {
   try {
     std::vector<std::string> names;
     for (int i = 0; i < n_attrs; ++i) names.emplace_back(attrs[i]);
     if (names.empty()) names.push_back("value");
-    return new mmtpu_space{CellularSpace(dim_x, dim_y, init, names)};
+    if (dtype_tag == static_cast<int>(DataType::kFloat64))
+      return new mmtpu_space{CellularSpace(dim_x, dim_y, init, names)};
+    if (dtype_tag == static_cast<int>(DataType::kFloat32))
+      return new mmtpu_space{CellularSpaceF32(dim_x, dim_y, init, names)};
+    set_error("unsupported space dtype tag " + std::to_string(dtype_tag) +
+              " (native engine instantiates f32=8 and f64=9)");
+    return nullptr;
   } catch (const std::exception& e) {
     set_error(e.what());
     return nullptr;
   }
 }
 
+mmtpu_space* mmtpu_space_create(int dim_x, int dim_y, double init,
+                                const char** attrs, int n_attrs) {
+  return create_space(dim_x, dim_y, init, attrs, n_attrs,
+                      static_cast<int>(DataType::kFloat64));
+}
+
+mmtpu_space* mmtpu_space_create_typed(int dim_x, int dim_y, double init,
+                                      const char** attrs, int n_attrs,
+                                      int dtype_tag) {
+  return create_space(dim_x, dim_y, init, attrs, n_attrs, dtype_tag);
+}
+
 void mmtpu_space_destroy(mmtpu_space* s) { delete s; }
 
-int mmtpu_space_dim_x(const mmtpu_space* s) { return s->cs.dim_x(); }
-int mmtpu_space_dim_y(const mmtpu_space* s) { return s->cs.dim_y(); }
+int mmtpu_space_dtype(const mmtpu_space* s) {
+  return std::visit(
+      [](const auto& cs) { return static_cast<int>(cs.dtype()); }, s->cs);
+}
 
+int mmtpu_space_dim_x(const mmtpu_space* s) {
+  return std::visit([](const auto& cs) { return cs.dim_x(); }, s->cs);
+}
+int mmtpu_space_dim_y(const mmtpu_space* s) {
+  return std::visit([](const auto& cs) { return cs.dim_y(); }, s->cs);
+}
+
+// Typed channel views: NULL + error when the space holds the other type
+// (a silently reinterpreted view is the exact bug class the tag exists
+// to stop).
 double* mmtpu_space_channel(mmtpu_space* s, const char* attr) {
   try {
-    return s->cs.channel(attr).data();
+    if (auto* cs = std::get_if<CellularSpace>(&s->cs))
+      return cs->channel(attr).data();
+    set_error("dtype mismatch: space is float32 — use "
+              "mmtpu_space_channel_f32");
+    return nullptr;
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return nullptr;
+  }
+}
+
+float* mmtpu_space_channel_f32(mmtpu_space* s, const char* attr) {
+  try {
+    if (auto* cs = std::get_if<CellularSpaceF32>(&s->cs))
+      return cs->channel(attr).data();
+    set_error("dtype mismatch: space is float64 — use mmtpu_space_channel");
+    return nullptr;
   } catch (const std::exception& e) {
     set_error(e.what());
     return nullptr;
@@ -97,7 +215,8 @@ double* mmtpu_space_channel(mmtpu_space* s, const char* attr) {
 
 double mmtpu_space_total(const mmtpu_space* s, const char* attr) {
   try {
-    return s->cs.total(attr);
+    return std::visit([&](const auto& cs) { return cs.total(attr); },
+                      s->cs);
   } catch (const std::exception& e) {
     set_error(e.what());
     return 0.0;
@@ -106,7 +225,7 @@ double mmtpu_space_total(const mmtpu_space* s, const char* attr) {
 
 int mmtpu_space_set(mmtpu_space* s, int x, int y, double v, const char* attr) {
   try {
-    s->cs.set(x, y, v, attr);
+    std::visit([&](auto& cs) { cs.set(x, y, v, attr); }, s->cs);
     return 0;
   } catch (const std::exception& e) {
     set_error(e.what());
@@ -114,43 +233,19 @@ int mmtpu_space_set(mmtpu_space* s, int x, int y, double v, const char* attr) {
   }
 }
 
-// Run `steps` flow steps on a lines x columns decomposition (1x1 = serial).
-// Returns 0 on success, 1 on conservation violation, -1 on error.
+// Run `steps` flow steps on a lines x columns decomposition (1x1 = serial)
+// in the space's own dtype (the f32 engine IS f32 math, not f64 over
+// views). Returns 0 on success, 1 on conservation violation, -1 on error.
 int mmtpu_run(mmtpu_space* s, const mmtpu_flow_spec* specs, int n_flows,
               int steps, int lines, int columns, int check_conservation,
               double tolerance, double* initial_total, double* final_total,
               double* conservation_error) {
   try {
-    std::vector<FlowPtr> flows;
-    for (int i = 0; i < n_flows; ++i) {
-      const auto& fs = specs[i];
-      std::string attr = fs.attr ? fs.attr : "value";
-      switch (fs.type) {
-        case 0:
-          flows.push_back(std::make_shared<PointFlow>(
-              fs.x, fs.y, fs.rate, attr,
-              fs.has_frozen ? std::optional<double>(fs.frozen)
-                            : std::nullopt));
-          break;
-        case 1:
-          flows.push_back(std::make_shared<Diffusion>(fs.rate, attr));
-          break;
-        case 2:
-          flows.push_back(std::make_shared<Coupled>(
-              fs.rate, attr, fs.modulator ? fs.modulator : "value"));
-          break;
-        default:
-          set_error("unknown flow type " + std::to_string(fs.type));
-          return -1;
-      }
-    }
-    Model model(flows);
-    Report rep;
-    if (lines * columns <= 1)
-      rep = model.execute(s->cs, steps, /*check=*/false);
-    else
-      rep = model.execute_threaded(s->cs, lines, columns, steps,
-                                   /*check=*/false);
+    Report rep = std::visit(
+        [&](auto& cs) {
+          return run_typed(cs, specs, n_flows, steps, lines, columns);
+        },
+        s->cs);
     if (initial_total) *initial_total = rep.initial_total;
     if (final_total) *final_total = rep.final_total;
     if (conservation_error) *conservation_error = rep.conservation_error;

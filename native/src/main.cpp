@@ -43,6 +43,7 @@ struct Args {
   std::string output;  // optional output dir
   int workers = 4;
   long halo_timeout_ms = 60000;  // 0 = unbounded (reference semantics)
+  std::string dtype = "float64";  // engine instantiation (reference's T)
 };
 
 Args parse(int argc, char** argv) {
@@ -74,6 +75,7 @@ Args parse(int argc, char** argv) {
     else if (eat("--flow", &v)) a.dense = (v == "diffusion");
     else if (eat("--halo-timeout-ms", &v)) a.halo_timeout_ms = std::stol(v);
     else if (eat("--output", &v)) a.output = v;
+    else if (eat("--dtype", &v)) a.dtype = v;
     else if (s == "--help" || s == "-h") {
       std::cout <<
         "mmtpu_main [--backend=native|threads|tpu] [--dimx=N --dimy=N]\n"
@@ -81,7 +83,8 @@ Args parse(int argc, char** argv) {
         "           [--source=x,y --rate=R --value=V --init=I]\n"
         "           [--flow=exponencial|diffusion]\n"
         "           [--lines=L --columns=C | --workers=N] [--output=DIR]\n"
-        "           [--halo-timeout-ms=MS]  (0 = unbounded recv)\n";
+        "           [--halo-timeout-ms=MS]  (0 = unbounded recv)\n"
+        "           [--dtype=float64|float32]  (engine instantiation)\n";
       exit(0);
     } else {
       std::cerr << "unknown flag: " << s << "\n";
@@ -93,7 +96,8 @@ Args parse(int argc, char** argv) {
 
 // Per-rank dumps + merged file: the reference's output handshake
 // (comm_rank%d.txt + "output <timestamp>.txt", Model.hpp:100-131,249-257).
-void write_output(const CellularSpace& cs, const Args& a, int ranks) {
+template <typename T>
+void write_output(const BasicCellularSpace<T>& cs, const Args& a, int ranks) {
   if (a.output.empty()) return;
   auto parts = a.lines > 0 && a.columns > 0
                    ? block_partitions(cs.dim_x(), cs.dim_y(), a.lines,
@@ -123,15 +127,16 @@ void write_output(const CellularSpace& cs, const Args& a, int ranks) {
             << " rank files + merged)\n";
 }
 
-int run_native(const Args& a, bool threaded) {
-  CellularSpace cs(a.dimx, a.dimy, a.init);
-  std::vector<FlowPtr> flows;
+template <typename T>
+int run_native_t(const Args& a, bool threaded) {
+  BasicCellularSpace<T> cs(a.dimx, a.dimy, a.init);
+  std::vector<BasicFlowPtr<T>> flows;
   if (a.dense)
-    flows.push_back(std::make_shared<Diffusion>(a.rate));
+    flows.push_back(std::make_shared<BasicDiffusion<T>>(a.rate));
   else
-    flows.push_back(std::make_shared<Exponencial>(
+    flows.push_back(std::make_shared<BasicExponencial<T>>(
         Cell(a.src_x, a.src_y, Attribute{99, a.value}), a.rate));
-  Model model(flows, a.time, a.time_step);
+  BasicModel<T> model(flows, a.time, a.time_step);
   int steps = a.use_time_loop ? model.num_steps() : a.steps;
 
   int lines = a.lines, columns = a.columns;
@@ -147,6 +152,7 @@ int run_native(const Args& a, bool threaded) {
                                               a.halo_timeout_ms)
                      : model.execute(cs, steps);
     std::cout << "backend=" << (threaded ? "threads" : "native")
+              << " dtype=" << a.dtype
               << " ranks=" << rep.comm_size << " steps=" << rep.steps
               << " initial=" << rep.initial_total
               << " final=" << rep.final_total
@@ -158,6 +164,14 @@ int run_native(const Args& a, bool threaded) {
     std::cerr << "error: " << e.what() << "\n";
     return 1;
   }
+}
+
+int run_native(const Args& a, bool threaded) {
+  if (a.dtype == "float64") return run_native_t<double>(a, threaded);
+  if (a.dtype == "float32") return run_native_t<float>(a, threaded);
+  std::cerr << "unknown --dtype '" << a.dtype
+            << "' (the native engine instantiates float64|float32)\n";
+  return 2;
 }
 
 int run_tpu(const Args& a, int argc, char** argv);

@@ -38,6 +38,21 @@ def test_two_process_cpu_dryrun():
     assert "pallas_deep_halo=ok" in line
 
 
+@pytest.mark.slow
+def test_four_process_kill_and_resume():
+    """The resilience story where a rank actually dies (round-4 VERDICT
+    task 7): a 4-process cluster checkpoints shardedly every 2 steps;
+    rank 2 dies hard after computing steps past the last commit (that
+    work is lost); a fresh 4-process cluster resumes the directory and
+    completes — BITWISE equal to an uninterrupted run, conserving."""
+    line = multihost.dryrun_supervised_kill(nprocs=4, port=29871)
+    assert "MASTER ok: procs=4" in line
+    assert "resumed_from=4" in line          # step-6 work died uncommitted
+    assert "final_step=10" in line
+    assert "conservation_err=0.000e+00" in line
+    assert "bitwise_resume=ok" in line
+
+
 def test_broadcast_str_rejects_overlong():
     """Silent truncation would corrupt a cluster-wide value; overlong
     strings are an error (single- and multi-process: the length check

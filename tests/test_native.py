@@ -32,7 +32,7 @@ def lib():
 
 
 def test_abi_version(lib):
-    assert lib.mmtpu_abi_version() == 1
+    assert lib.mmtpu_abi_version() == 2  # v2: typed spaces + typed wire
 
 
 def test_native_space_roundtrip():
@@ -162,3 +162,84 @@ def test_native_recv_timeout_detects_dead_rank():
     assert selftest_recv_timeout(timeout_ms=200) is True
     # detected in bounded time, not an eternal hang
     assert time.perf_counter() - t0 < 30
+
+
+# -- typed engine (round-5: f32/f64 channel store, typed wire) ---------------
+
+def test_native_f32_space_roundtrip():
+    ns = native.NativeSpace(10, 8, 1.5, dtype="float32")
+    assert ns.channel().dtype == np.float32
+    assert ns.total() == pytest.approx(10 * 8 * 1.5)
+    ns.set(3, 4, 9.0)
+    assert ns.channel()[3, 4] == np.float32(9.0)
+    with pytest.raises(ValueError, match="float32/float64"):
+        native.NativeSpace(4, 4, dtype="bfloat16")
+
+
+def test_native_f32_matches_f32_oracle():
+    """The f32 engine is TRUE f32 math: golden vs the NumPy oracle
+    evaluated in f32 (not an f64 run cast down)."""
+    rng = np.random.default_rng(13)
+    init = rng.uniform(0.5, 2.0, (24, 20)).astype(np.float32)
+    ns = native.NativeSpace(24, 20, 0.0, dtype="float32")
+    np.copyto(ns.channel(), init)
+    ns.run([Diffusion(0.1), PointFlow(source=(5, 5), flow_rate=0.5)],
+           steps=4, check_conservation=False)
+
+    want = init.copy()
+    for _ in range(4):
+        amt = np.float32(0.5) * want[5, 5]
+        want = oracle.dense_flow_step_np(want, np.float32(0.1))
+        want = oracle.point_flow_step_np(want, 5, 5, amt)
+    assert want.dtype == np.float32
+    got = ns.channel()
+    # same dtype, same update structure: agreement far below f32 eps
+    # per step would be impossible if the engine computed in f64
+    np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-6)
+
+
+@pytest.mark.parametrize("lines,columns", [(1, 1), (2, 4)])
+def test_native_f32_executor_matches_f32_jax(lines, columns):
+    """Cross-backend golden in BOTH dtypes (round-4 VERDICT task 6):
+    an f32 space runs the native f32 engine instantiation and matches
+    the f32 JAX path within f32 tolerance; f64 stays exact."""
+    for dtype, tol in ((jnp.float32, 1e-5), (jnp.float64, 1e-10)):
+        space = CellularSpace.create(16, 32, {"a": 1.0, "b": 2.0},
+                                     dtype=dtype)
+        flows = [Coupled(flow_rate=0.05, attr="a", modulator="b"),
+                 Diffusion(0.1, attr="b")]
+        want, _ = Model(flows, 4.0, 1.0).execute(space)
+        ex = native.NativeExecutor(lines=lines, columns=columns)
+        got, rep = Model(flows, 4.0, 1.0).execute(space, ex)
+        assert ex.last_backend_report["engine"] == "native-c++"
+        for k in ("a", "b"):
+            assert got.values[k].dtype == space.values[k].dtype
+            np.testing.assert_allclose(got.to_numpy()[k],
+                                       want.to_numpy()[k],
+                                       rtol=tol, atol=tol)
+
+
+def test_native_typed_wire_rejects_mismatch():
+    """The typed comm layer: an f32 halo slab received as f64 is a
+    diagnosable dtype error inside the engine, and matching types
+    round-trip (the reference's Send<T>/Receive<T>, now enforced)."""
+    from mpi_model_tpu.native import selftest_typed_wire
+
+    assert selftest_typed_wire() is True
+
+
+def test_driver_dtype_flag():
+    """The native driver's --dtype flag: the reference's compile-time T
+    template parameter as a runtime switch, both backends conserving."""
+    exe = os.path.join(native._NATIVE_DIR, "build", "mmtpu_main")
+    if not os.path.exists(exe):
+        pytest.skip("driver not built")
+    out = subprocess.run(
+        [exe, "--backend=threads", "--dtype=float32", "--dimx=24",
+         "--dimy=24", "--steps=2", "--workers=4", "--source=5,5"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "dtype=float32" in out.stdout and "CONSERVED" in out.stdout
+    bad = subprocess.run([exe, "--dtype=int8"], capture_output=True,
+                         text=True, timeout=60)
+    assert bad.returncode == 2 and "float64|float32" in bad.stderr

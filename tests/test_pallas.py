@@ -243,32 +243,13 @@ def test_tpu_hardware_halo_mode():  # pragma: no cover - TPU only
 # Mosaic (slab DMAs carrying real neighbor data, nonzero SMEM origins,
 # three-way corner variants, multi-step ring consumption).
 
-def _cut(G, rs, re, cs, ce, dtype):
-    """G[rs:re, cs:ce] with zero-fill outside the grid (= what ppermute
-    delivers to a shard at the true grid edge)."""
-    H, W = G.shape
-    out = np.zeros((re - rs, ce - cs), G.dtype)
-    rs_c, re_c = max(rs, 0), min(re, H)
-    cs_c, ce_c = max(cs, 0), min(ce, W)
-    if rs_c < re_c and cs_c < ce_c:
-        out[rs_c - rs:re_c - rs, cs_c - cs:ce_c - cs] = G[rs_c:re_c,
-                                                          cs_c:ce_c]
-    return jnp.asarray(out, dtype)
-
-
 def _ring_from_global(G, r0, c0, h, w, d, dtype):
     """The depth-d ghost ring a shard at (r0, c0) would receive from the
-    two-stage ppermute exchange, cut directly from the global grid."""
-    return {
-        "n": _cut(G, r0 - d, r0, c0, c0 + w, dtype),
-        "s": _cut(G, r0 + h, r0 + h + d, c0, c0 + w, dtype),
-        "w": _cut(G, r0, r0 + h, c0 - d, c0, dtype),
-        "e": _cut(G, r0, r0 + h, c0 + w, c0 + w + d, dtype),
-        "nw": _cut(G, r0 - d, r0, c0 - d, c0, dtype),
-        "ne": _cut(G, r0 - d, r0, c0 + w, c0 + w + d, dtype),
-        "sw": _cut(G, r0 + h, r0 + h + d, c0 - d, c0, dtype),
-        "se": _cut(G, r0 + h, r0 + h + d, c0 + w, c0 + w + d, dtype),
-    }
+    two-stage ppermute exchange (oracle.ring_from_global_np), as jnp."""
+    from mpi_model_tpu.oracle import ring_from_global_np
+
+    return {k: jnp.asarray(v, dtype)
+            for k, v in ring_from_global_np(G, r0, c0, h, w, d).items()}
 
 
 # (shard h, w), block, origin divisors, ring depth d, fused steps ns.
@@ -595,6 +576,62 @@ def test_field_kernel_composes_with_point_flow():
     want = m2.make_step(space, impl="xla")(dict(vals))
     np.testing.assert_allclose(np.asarray(got["a"]), np.asarray(want["a"]),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_field_kernel_compute_dtype_knob():
+    """compute_dtype=bfloat16 (interior math) stays within bf16
+    tolerance of the XLA oracle path; f32 stays tight — and the knob is
+    reachable through make_step (distinct cache entries)."""
+    from mpi_model_tpu.ops.pallas_stencil import PallasFieldStep
+
+    space, model, vals = _coupled_setup(h=40, w=640)
+    sx = model.make_step(space, impl="xla")
+    want = dict(vals)
+    for _ in range(4):
+        want = sx(want)
+    for cdt, tol in ((jnp.float32, 1e-4), (jnp.bfloat16, 0.05)):
+        stepper = PallasFieldStep((40, 640), model.flows, block=(8, 128),
+                                  interpret=True, nsteps=4,
+                                  compute_dtype=cdt)
+        got = stepper(dict(vals))
+        for k in ("a", "b"):
+            np.testing.assert_allclose(np.asarray(got[k]),
+                                       np.asarray(want[k]),
+                                       rtol=tol, atol=tol)
+    s_bf = model.make_step(space, impl="pallas", compute_dtype=jnp.bfloat16)
+    s_f32 = model.make_step(space, impl="pallas")
+    assert s_bf is not s_f32  # compute_dtype is part of the step identity
+    out = s_bf(dict(vals))
+    np.testing.assert_allclose(np.asarray(out["a"]),
+                               np.asarray(sx(dict(vals))["a"]),
+                               rtol=0.05, atol=0.05)
+
+
+def test_halo_kernel_compute_dtype_knob():
+    """The sharded halo kernels accept the knob too: bf16 interior math
+    on a real-ring shard stays within bf16 tolerance of the global
+    oracle (interpret twin of the silicon geometry)."""
+    import zlib
+
+    from mpi_model_tpu.ops.pallas_stencil import pallas_halo_step
+
+    shape, block, d, ns = (256, 384), (128, 128), 8, 4
+    h, w = shape
+    H, W = 4 * h, 4 * w
+    rng = np.random.default_rng(zlib.crc32(b"cdt-halo"))
+    G = rng.uniform(0.5, 2.0, (H, W))
+    r0, c0 = 2 * h, w
+    want = G.copy()
+    for _ in range(ns):
+        want = dense_flow_step_np(want, 0.17)
+    want = want[r0:r0 + h, c0:c0 + w]
+    shard = jnp.asarray(G[r0:r0 + h, c0:c0 + w], jnp.bfloat16)
+    ring = _ring_from_global(G, r0, c0, h, w, d, jnp.bfloat16)
+    got = np.asarray(pallas_halo_step(
+        shard, ring, jnp.asarray([r0, c0], jnp.int32), (H, W), 0.17,
+        block=block, interpret=True, nsteps=ns,
+        compute_dtype=jnp.bfloat16), np.float64)
+    np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
 
 
 def test_field_kernel_rejects_non_pointwise():
