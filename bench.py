@@ -55,13 +55,40 @@ driver's single-number entry point.
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 RATE = 0.1
 
 
+def enable_compile_cache() -> None:
+    """Persistent compilation cache: the gates + kernel compiles
+    dominate the bench's wall clock; a warm cache turns repeat runs —
+    including the driver's — into pure measurement. jax.config.update
+    works after jax import, so this also covers callers (the ladder)
+    that initialized jax before importing this module."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                         "/tmp/mmtpu_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:
+        pass  # older jax without the knobs: cache is an optimization only
+
+
 def _tols(substeps: int) -> dict:
     return {"float32": 1e-5 * max(1, substeps), "bfloat16": 0.04}
+
+
+def _max_err(a, b) -> float:
+    """max|a - b| computed ON the device in f32 — the bench-size arrays
+    are 16384²; f64 host copies would transiently cost ~2GB apiece."""
+    import jax.numpy as jnp
+
+    return float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                 - b.astype(jnp.float32))))
 
 
 def validate_on_device(substeps: int, dtype_name: str = "bfloat16",
@@ -180,11 +207,11 @@ def bench_halo_mode(space, model, dense_step, substeps: int,
         return {"halo_impl": ex.last_impl}  # honest: overhead not measured
     # at-geometry gate: one fused chunk through the sharded path must
     # match the dense kernel at the size being timed (both compute f32
-    # internally; bf16 storage rounding bounds the difference)
+    # internally; bf16 storage rounding bounds the difference). The
+    # reduction runs ON DEVICE — f64 host copies of a 16384² grid cost
+    # ~2GB each
     want = dense_step(dict(space.values))
-    err = float(np.abs(
-        np.asarray(out["value"], np.float64)
-        - np.asarray(want["value"], np.float64)).max())
+    err = _max_err(out["value"], want["value"])
     tol = _tols(substeps)[str(space.dtype)]
     if err > tol:
         raise AssertionError(
@@ -221,6 +248,7 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
         raise ValueError(
             f"bench supports float32/bfloat16, not {dtype_name!r}")
 
+    enable_compile_cache()
     validated = validate_on_device(substeps, dtype_name, verbose=verbose)
     validate_halo_on_device(substeps, dtype_name, verbose=verbose)
 
@@ -260,9 +288,7 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
         want = dict(space.values)
         for _ in range(substeps):
             want = xla_step(want)
-        err = float(np.abs(
-            np.asarray(got["value"], np.float64)
-            - np.asarray(want["value"], np.float64)).max())
+        err = _max_err(got["value"], want["value"])
         tol = _tols(substeps)[dtype_name]
         if err > tol:
             raise AssertionError(

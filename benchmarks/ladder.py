@@ -472,12 +472,10 @@ def field_compute_dtype_ab(grid: int, flows, nsteps: int = 1,
     ``compute_dtype_ab`` — round-4 VERDICT task 5: the workload where
     per-cell outflow evaluation dominates never got the bf16-interior
     measurement)."""
-    import statistics
-
     import jax.numpy as jnp
 
     from mpi_model_tpu.ops.pallas_stencil import PallasFieldStep
-    from mpi_model_tpu.utils import marginal_step_time
+    from mpi_model_tpu.utils import interleaved_ab
 
     attrs = sorted({f.attr for f in flows} | {getattr(f, "modulator", f.attr)
                                              for f in flows})
@@ -488,12 +486,7 @@ def field_compute_dtype_ab(grid: int, flows, nsteps: int = 1,
         "bf16": PallasFieldStep((grid, grid), flows, interpret=False,
                                 nsteps=nsteps, compute_dtype=jnp.bfloat16),
     }
-    times: dict[str, list] = {"f32": [], "bf16": []}
-    for _ in range(reps):  # interleaved: chip-state drift hits both arms
-        for name, stepper in steppers.items():
-            times[name].append(marginal_step_time(
-                stepper, v0, s1=5, s2=25, reps=1))
-    med = {k: statistics.median(v) for k, v in times.items()}
+    med = interleaved_ab(steppers, v0, s1=5, s2=25, reps=reps)
     return {"field_f32_compute_step_ms": med["f32"] * 1e3 / nsteps,
             "field_bf16_compute_step_ms": med["bf16"] * 1e3 / nsteps,
             "bf16_compute_speedup": (med["f32"] / med["bf16"]
@@ -559,18 +552,15 @@ def config4(quick: bool = False) -> dict:
 def compute_dtype_ab(grid: int = 16384, nsteps: int = 4,
                      reps: int = 4) -> dict:
     """bf16-storage kernel with f32 vs bf16 INTERIOR math, interleaved
-    A/B trials (tunnel noise discipline): does trading interior
+    A/B medians (tunnel noise discipline): does trading interior
     precision for VPU throughput pay when the fused kernel is
     VPU-bound? (round-3 VERDICT missing #4 follow-through)"""
-    import statistics
-
     import jax.numpy as jnp
 
     from mpi_model_tpu.ops.pallas_stencil import pallas_dense_step
-    from mpi_model_tpu.utils import marginal_step_time
+    from mpi_model_tpu.utils import interleaved_ab
 
     v0 = {"value": jnp.ones((grid, grid), dtype=jnp.bfloat16)}
-    times: dict[str, list] = {"f32": [], "bf16": []}
     steps = {}
     for name, cdt in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
         def step(vals, _c=cdt):
@@ -578,11 +568,7 @@ def compute_dtype_ab(grid: int = 16384, nsteps: int = 4,
                 vals["value"], 0.1, nsteps=nsteps, compute_dtype=_c,
                 interpret=False)}
         steps[name] = step
-    for _ in range(reps):  # interleaved: chip-state drift hits both arms
-        for name, step in steps.items():
-            times[name].append(marginal_step_time(step, v0, s1=5, s2=25,
-                                                  reps=1))
-    med = {k: statistics.median(v) for k, v in times.items()}
+    med = interleaved_ab(steps, v0, s1=5, s2=25, reps=reps)
     return {"f32_compute_step_ms": med["f32"] * 1e3 / nsteps,
             "bf16_compute_step_ms": med["bf16"] * 1e3 / nsteps,
             "bf16_compute_speedup": (med["f32"] / med["bf16"]
@@ -689,6 +675,10 @@ def main(argv=None) -> int:
     ap.add_argument("--sweep", action="store_true",
                     help="run the Pallas block-size sweep instead")
     args = ap.parse_args(argv)
+
+    import bench as bench_mod
+
+    bench_mod.enable_compile_cache()  # the TPU configs recompile heavily
 
     if args.sweep:
         for row in sweep_blocks():

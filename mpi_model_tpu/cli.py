@@ -179,6 +179,11 @@ def cmd_run(args) -> int:
             "--rectangular IS the mesh (a lines x columns block "
             "decomposition); drop --mesh")
     if args.executor == "gspmd":
+        if args.rectangular is not None:
+            raise SystemExit(
+                "--rectangular always runs the explicit block-halo "
+                "ShardMapExecutor (its owner map IS that mesh); for the "
+                "GSPMD path use --mesh=LxC --executor=gspmd")
         if args.mesh is None:
             raise SystemExit("--executor=gspmd is a sharded path; add "
                              "--mesh=LxC")

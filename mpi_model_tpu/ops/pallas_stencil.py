@@ -537,6 +537,199 @@ def _pallas_step(v: jax.Array, *, rate: float,
                          nsteps=nsteps, compute_dtype=compute_dtype)
 
 
+# -- pipelined dense kernel (nine Blocked specs, no manual DMA) --------------
+
+#: row/col strip granularities of the pipelined window. 16 rows is one
+#: bf16 sublane tile (and two f32 tiles); 128 cols is the lane tile.
+_STRIP_R = 16
+_STRIP_C = 128
+
+
+def _pipeline_blocks(h: int, w: int) -> Optional[tuple[int, int]]:
+    """(BR, BC) for the pipelined dense kernel, or None when the grid
+    can't host it: BR | h with BR % 16 == 0, BC | w with BC % 128 == 0.
+    (512, 2048) measured fastest at 16384² (round-5 sweep); preference
+    walks down from there."""
+    def pick(dim, pref, align):
+        for b in range(min(dim, pref), align - 1, -1):
+            if dim % b == 0 and b % align == 0:
+                return b
+        return None
+
+    br = pick(h, 512, _STRIP_R)
+    bc = pick(w, 2048, _STRIP_C)
+    if br is None or bc is None:
+        return None
+    return br, bc
+
+
+def _pipeline_call(v, *, rate, block, offsets, interpret, nsteps,
+                   compute_dtype=jnp.float32):
+    """Dense fused-stencil kernel with the halo window expressed as NINE
+    Blocked in_specs at mixed granularities — centre (BR, BC), row
+    strips (16, BC) at row-block ``RB*i - 1`` / ``RB*i + RB``, column
+    strips (BR, 128), corners (16, 128) — all with INTEGER block-index
+    maps, so the pallas grid pipeline prefetches every piece natively
+    (double-buffered by the runtime, zero manual DMA/semaphore code).
+    Measured 1.5-1.7x the manual-window kernel at the bench geometry
+    (round-5: 2.1 vs 3.2-3.7 ms/step at 16384² bf16 x4).
+
+    Perimeter fetches CLAMP their block index: the clamped pieces carry
+    in-grid garbage exactly where the true window would be off-grid, and
+    every tile whose window touches the grid edge takes the exact
+    masked path (mask from GLOBAL coordinates), which zeroes those
+    positions — the same invariant the windowed kernel's zeroed scratch
+    border provides. Interior tiles never read a clamped piece.
+
+    Constraints (``_pipeline_blocks`` + caller): dense mode only, grid
+    divisible into (BR % 16, BC % 128) tiles, ``nsteps <= 8`` (the row
+    strips carry an 8-deep usable ring).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    h, w = v.shape
+    bh, bw = block
+    RB = bh // _STRIP_R
+    CB = bw // _STRIP_C
+    gi, gj = h // bh, w // bw
+    nrb = h // _STRIP_R - 1
+    ncb = w // _STRIP_C - 1
+    is_moore = set(offsets) == set(MOORE_OFFSETS)
+    k = float(len(offsets))
+    ns = nsteps
+    _i32 = np.int32
+    # index-map arithmetic pinned to i32: bare Python ints become weak
+    # i64 under jax_enable_x64 and Mosaic's scalar lowering recurses
+    # forever on the resulting convert (the round-2 incident class)
+    RB32, CB32, one = _i32(RB), _i32(CB), _i32(1)
+
+    def _cl(x, hi):
+        return jnp.clip(x, _i32(0), _i32(hi))
+
+    def kernel(mid_ref, top_ref, bot_ref, lef_ref, rig_ref,
+               tl_ref, tr_ref, bl_ref, br_ref, o_ref):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        # assemble the (bh + 16, bw + 256) window: 8-row / 128-col halo
+        # pieces keep every concat sublane/lane aligned
+        left = jnp.concatenate(
+            [tl_ref[8:16, :], lef_ref[...], bl_ref[0:8, :]],
+            axis=0).astype(jnp.float32)
+        mid = jnp.concatenate(
+            [top_ref[8:16, :], mid_ref[...], bot_ref[0:8, :]],
+            axis=0).astype(jnp.float32)
+        right = jnp.concatenate(
+            [tr_ref[8:16, :], rig_ref[...], br_ref[0:8, :]],
+            axis=0).astype(jnp.float32)
+        win = jnp.concatenate([left, mid, right], axis=1)
+
+        MH, MW = bh + 2 * ns, bw + 2 * ns
+        region = win[8 - ns:8 + bh + ns, 128 - ns:128 + bw + ns]
+        g_r0 = i * _i32(bh)
+        g_c0 = j * _i32(bw)
+        near = ((g_r0 <= ns) | (g_r0 + bh >= h - ns)
+                | (g_c0 <= ns) | (g_c0 + bw >= w - ns))
+
+        @pl.when(jnp.logical_not(near))
+        def _():
+            cur = region.astype(compute_dtype)
+            for _ in range(ns):
+                hs, ws = cur.shape
+                if is_moore:
+                    band = (cur[0:hs - 2, :] + cur[1:hs - 1, :]
+                            + cur[2:hs, :])
+                    nine = (band[:, 0:ws - 2] + band[:, 1:ws - 1]
+                            + band[:, 2:ws])
+                    cur = (cur[1:hs - 1, 1:ws - 1]
+                           * (1.0 - rate - rate / k) + nine * (rate / k))
+                else:
+                    g = None
+                    for dx, dy in offsets:
+                        t = cur[1 + dx:hs - 1 + dx, 1 + dy:ws - 1 + dy]
+                        g = t if g is None else g + t
+                    cur = (cur[1:hs - 1, 1:ws - 1] * (1.0 - rate)
+                           + g * (rate / k))
+            o_ref[...] = cur.astype(o_ref.dtype)
+
+        # exact masked path for ring-adjacent tiles: clamped perimeter
+        # fetches put garbage where the window is off-grid; the mask
+        # (global coordinates) zeroes exactly those cells, and the
+        # per-cell-count form handles the boundary divisor
+        @pl.when(near)
+        def _():
+            row_g = (g_r0 - _i32(ns)) + lax.broadcasted_iota(
+                jnp.int32, (MH, MW), 0)
+            col_g = (g_c0 - _i32(ns)) + lax.broadcasted_iota(
+                jnp.int32, (MH, MW), 1)
+            mask = ((row_g >= 0) & (row_g < h)
+                    & (col_g >= 0) & (col_g < w)).astype(jnp.float32)
+            cnt = jnp.zeros((MH, MW), jnp.float32)
+            for dx, dy in offsets:
+                ok = ((row_g + _i32(dx) >= 0) & (row_g + _i32(dx) < h)
+                      & (col_g + _i32(dy) >= 0) & (col_g + _i32(dy) < w))
+                cnt = cnt + ok.astype(jnp.float32)
+            cnt = jnp.maximum(cnt, 1.0)
+            c2 = region * mask
+            for s in range(ns):
+                hs, ws = c2.shape
+                share = (rate * c2) / cnt[s:MH - s, s:MW - s]
+                g = None
+                for dx, dy in offsets:
+                    t = share[1 + dx:hs - 1 + dx, 1 + dy:ws - 1 + dy]
+                    g = t if g is None else g + t
+                c2 = ((c2[1:hs - 1, 1:ws - 1] * (1.0 - rate) + g)
+                      * mask[s + 1:MH - s - 1, s + 1:MW - s - 1])
+            o_ref[...] = c2.astype(o_ref.dtype)
+
+    specs = [
+        pl.BlockSpec((bh, bw), lambda i, j: (i, j)),
+        pl.BlockSpec((_STRIP_R, bw),
+                     lambda i, j: (_cl(RB32 * i - one, nrb), j)),
+        pl.BlockSpec((_STRIP_R, bw),
+                     lambda i, j: (_cl(RB32 * i + RB32, nrb), j)),
+        pl.BlockSpec((bh, _STRIP_C),
+                     lambda i, j: (i, _cl(CB32 * j - one, ncb))),
+        pl.BlockSpec((bh, _STRIP_C),
+                     lambda i, j: (i, _cl(CB32 * j + CB32, ncb))),
+        pl.BlockSpec((_STRIP_R, _STRIP_C),
+                     lambda i, j: (_cl(RB32 * i - one, nrb),
+                                   _cl(CB32 * j - one, ncb))),
+        pl.BlockSpec((_STRIP_R, _STRIP_C),
+                     lambda i, j: (_cl(RB32 * i - one, nrb),
+                                   _cl(CB32 * j + CB32, ncb))),
+        pl.BlockSpec((_STRIP_R, _STRIP_C),
+                     lambda i, j: (_cl(RB32 * i + RB32, nrb),
+                                   _cl(CB32 * j - one, ncb))),
+        pl.BlockSpec((_STRIP_R, _STRIP_C),
+                     lambda i, j: (_cl(RB32 * i + RB32, nrb),
+                                   _cl(CB32 * j + CB32, ncb))),
+    ]
+    return pl.pallas_call(
+        kernel,
+        grid=(gi, gj),
+        in_specs=specs,
+        out_specs=pl.BlockSpec((bh, bw), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((h, w), v.dtype),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=110 * 1024 * 1024),
+        interpret=interpret,
+    )(*([v] * 9))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("rate", "block", "offsets", "interpret",
+                                    "nsteps", "compute_dtype"))
+def _pallas_pipeline_step(v: jax.Array, *, rate: float,
+                          block: tuple[int, int],
+                          offsets: tuple[tuple[int, int], ...],
+                          interpret: bool, nsteps: int = 1,
+                          compute_dtype=jnp.float32) -> jax.Array:
+    return _pipeline_call(v, rate=rate, block=block, offsets=offsets,
+                          interpret=interpret, nsteps=nsteps,
+                          compute_dtype=compute_dtype)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("rate", "block", "offsets", "interpret",
                                     "global_shape", "nsteps",
@@ -698,6 +891,7 @@ def pallas_dense_step(
     interpret: Optional[bool] = None,
     nsteps: int = 1,
     compute_dtype=None,
+    pipeline: Optional[bool] = None,
 ) -> jax.Array:
     """``nsteps`` fused dense flow steps in one HBM round-trip: every
     cell sheds ``rate * value`` split equally among its in-bounds
@@ -706,11 +900,46 @@ def pallas_dense_step(
     ``flow_step(values, rate * ones, counts)``; larger ``nsteps``
     amortizes the memory traffic over the steps (the HBM-bandwidth
     lever) and is exact up to the window's ghost depth
-    (``min(sublane, bh)`` rows — 8 f32 / 16 bf16 at default blocks)."""
+    (``min(sublane, bh)`` rows — 8 f32 / 16 bf16 at default blocks).
+
+    ``pipeline=True`` selects the NINE-SPEC pipelined window kernel
+    (``_pipeline_call``). It is NOT the default: it wins 1.4x on
+    repeated-same-input dispatch (independent invocations of one
+    buffer) but LOSES ~1.45x under the production chained scan, where
+    each step reads the buffer the previous step just wrote — measured
+    both ways at 16384² bf16 x4 with interleaved medians (round-5
+    roofline investigation, BASELINE.md). Kept as a correct, tested
+    alternative for workloads with the favorable dispatch pattern."""
     offsets = check_offsets(offsets)
+    if nsteps < 1:
+        raise ValueError(f"nsteps must be >= 1, got {nsteps}")
     h, w = values.shape
     if interpret is None:
         interpret = resolve_interpret(values)
+    if compute_dtype is None:
+        # f32 interior math by default — bf16 grids gain accuracy from
+        # f32 shares; pass compute_dtype=jnp.bfloat16 to trade interior
+        # precision for VPU throughput in the multi-step loop (the
+        # near-ring path always computes in f32)
+        compute_dtype = jnp.float32
+    if pipeline:
+        if block is not None:
+            # honor an explicit block (sweeps must time what they label)
+            bh, bw = _validate_block(h, w, block)
+            pipe_block = ((bh, bw)
+                          if bh % _STRIP_R == 0 and bw % _STRIP_C == 0
+                          else None)
+        else:
+            pipe_block = _pipeline_blocks(h, w)
+        if pipe_block is None or nsteps > 8:
+            raise ValueError(
+                f"pipeline=True needs a grid (and any explicit block) "
+                f"divisible into 16-row/128-col strips and nsteps <= 8; "
+                f"got {(h, w)} block={block} nsteps={nsteps}")
+        return _pallas_pipeline_step(
+            values, rate=float(rate), block=pipe_block, offsets=offsets,
+            interpret=bool(interpret), nsteps=int(nsteps),
+            compute_dtype=jnp.dtype(compute_dtype))
     if block is None:
         sub = _sublane(values.dtype)
         # (512, 512) benches fastest at 8192^2 on v5e; double-buffered
@@ -719,12 +948,6 @@ def pallas_dense_step(
         block = (_pick_block(h, 512, sub), _pick_block(w, 512, LANE))
     else:
         block = _validate_block(h, w, block)
-    if compute_dtype is None:
-        # f32 interior math by default — bf16 grids gain accuracy from
-        # f32 shares; pass compute_dtype=jnp.bfloat16 to trade interior
-        # precision for VPU throughput in the multi-step loop (the
-        # near-ring path always computes in f32)
-        compute_dtype = jnp.float32
     return _pallas_step(values, rate=float(rate),
                         block=tuple(block), offsets=offsets,
                         interpret=bool(interpret), nsteps=int(nsteps),

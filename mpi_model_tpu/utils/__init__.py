@@ -1,6 +1,6 @@
-from .metrics import (marginal_runner_time, marginal_runner_trials,
-                      marginal_step_time, marginal_step_trials,
-                      median_spread)
+from .metrics import (interleaved_ab, marginal_runner_time,
+                      marginal_runner_trials, marginal_step_time,
+                      marginal_step_trials, median_spread)
 from .roofline import chip_peaks, stencil_roofline
 from .tracing import Span, Tracer, get_tracer, set_tracer, trace_span
 
@@ -10,6 +10,7 @@ __all__ = [
     "median_spread",
     "marginal_runner_time",
     "marginal_runner_trials",
+    "interleaved_ab",
     "chip_peaks",
     "stencil_roofline",
     "Span",

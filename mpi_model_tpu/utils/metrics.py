@@ -119,6 +119,24 @@ def marginal_runner_trials(make_output: Callable[[int], object],
     return out
 
 
+def interleaved_ab(steps: dict, values: Values, *, s1: int = 5,
+                   s2: int = 25, reps: int = 4) -> dict:
+    """Interleaved A/B medians: one marginal sample per arm per round,
+    arms alternating so chip-state drift on the shared tunnel chip hits
+    every arm of a round together (BASELINE.md's noise discipline —
+    speedup claims are only made when they survive interleaving).
+    ``steps`` maps arm name → step function; returns arm name → median
+    marginal seconds per step call."""
+    import statistics
+
+    times: dict = {name: [] for name in steps}
+    for _ in range(reps):
+        for name, step in steps.items():
+            times[name].append(marginal_step_time(step, values,
+                                                  s1=s1, s2=s2, reps=1))
+    return {name: statistics.median(ts) for name, ts in times.items()}
+
+
 def median_spread(samples: list[float]) -> dict:
     """{value: median, spread_lo: min, spread_hi: max} of the samples —
     the shape BENCH/ladder rows report so successive rounds don't read

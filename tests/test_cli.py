@@ -294,3 +294,10 @@ def test_cli_new_flag_validation():
     for argv, match in cases:
         with pytest.raises(SystemExit, match=match):
             cli.main(argv)
+
+
+def test_cli_rectangular_gspmd_rejected_clearly():
+    """--rectangular + --executor=gspmd must give ONE clear error, not
+    bounce the user between 'add --mesh' and 'drop --mesh'."""
+    with pytest.raises(SystemExit, match="ShardMapExecutor"):
+        cli.main(["run", "--rectangular=2x3", "--executor=gspmd"])

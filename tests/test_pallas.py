@@ -391,6 +391,91 @@ def test_field_halo_real_shard_interpret():
     _field_halo_case(jnp.float32, True, 2, 2)
 
 
+# -- pipelined dense kernel (nine Blocked specs; round-5 roofline work) ------
+
+@pytest.mark.parametrize("shape,block,ns,offs", [
+    # (16,128) blocks on a 4x4-tile grid: GENUINE interior tiles (the
+    # fast path) AND clamped perimeter strip fetches across real tile
+    # boundaries — auto-picked blocks would make every grid one tile
+    ((64, 512), (16, 128), 1, MOORE_OFFSETS),
+    ((64, 512), (16, 128), 4, MOORE_OFFSETS),
+    ((80, 640), (16, 128), 8, MOORE_OFFSETS),   # 5x5 tiles, max depth
+    ((64, 512), (16, 128), 2, VON_NEUMANN_OFFSETS),
+    ((64, 512), (16, 128), 2, ((-1, 0), (1, 1), (0, -1))),
+    ((48, 256), (16, 256), 3, MOORE_OFFSETS),   # 3x1 tiles: row seams
+    ((16, 128), None, 3, MOORE_OFFSETS),  # single tile: all-near path
+    ((64, 256), None, 4, MOORE_OFFSETS),  # auto block
+])
+def test_pipeline_kernel_matches_oracle(shape, block, ns, offs):
+    """The nine-spec pipelined kernel == the composed oracle, including
+    interior tiles fed across genuine tile boundaries, the boundary
+    divisor behavior (clamped perimeter fetches must be fully masked),
+    and non-Moore neighborhoods."""
+    v = _grid(*shape)
+    want = v.astype(np.float64)
+    for _ in range(ns):
+        want = dense_flow_step_np(want, 0.11, offsets=offs)
+    got = np.asarray(pallas_dense_step(
+        jnp.asarray(v), 0.11, offsets=offs, block=block, interpret=True,
+        nsteps=ns, pipeline=True), np.float64)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_matches_windowed_kernel():
+    """Both dense implementations agree bitwise-ish on the same input
+    (identical f32 interior math; different fetch machinery only)."""
+    v = jnp.asarray(_grid(64, 512))
+    a = pallas_dense_step(v, 0.13, interpret=True, nsteps=4, pipeline=True)
+    b = pallas_dense_step(v, 0.13, interpret=True, nsteps=4, pipeline=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_pipeline_ineligible_raises_and_auto_falls_back():
+    v_np = _grid(13, 17)  # indivisible by 16/128 strips
+    v = jnp.asarray(v_np)
+    with pytest.raises(ValueError, match="pipeline"):
+        pallas_dense_step(v, 0.1, interpret=True, pipeline=True)
+    # auto: silently uses the windowed kernel
+    got = np.asarray(pallas_dense_step(v, 0.1, interpret=True))
+    np.testing.assert_allclose(got, dense_flow_step_np(v_np, 0.1),
+                               rtol=1e-6, atol=1e-6)
+
+
+@needs_tpu
+def test_tpu_pipeline_kernel():  # pragma: no cover - TPU only
+    """The pipelined kernel on real Mosaic: a 4x8-tile geometry with
+    GENUINE interior tiles (fast path + all nine fetch streams crossing
+    real tile boundaries), boundary tiles with clamped fetches, 4-step
+    fusion, both storage dtypes."""
+    tpu = [d for d in jax.devices() if d.platform == "tpu"][0]
+    with jax.default_device(tpu):
+        v = _grid(1024, 2048)
+        want = v.astype(np.float64)
+        for _ in range(4):
+            want = dense_flow_step_np(want, 0.1)
+        for dtype, tol in ((np.float32, 1e-5), (jnp.bfloat16, 0.04)):
+            got = np.asarray(pallas_dense_step(
+                jnp.asarray(v, dtype), 0.1, block=(256, 256),
+                interpret=False, nsteps=4, pipeline=True), np.float64)
+            np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+
+
+def test_pipeline_explicit_block_honored_or_rejected():
+    """pipeline=True with an explicit block must RUN that block (sweeps
+    time what they label) or raise for strip-unaligned blocks — never
+    silently substitute another geometry."""
+    v_np = _grid(64, 512)
+    v = jnp.asarray(v_np)
+    want = dense_flow_step_np(v_np, 0.1)
+    got = np.asarray(pallas_dense_step(v, 0.1, block=(32, 256),
+                                       interpret=True, pipeline=True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="pipeline"):
+        pallas_dense_step(v, 0.1, block=(8, 128), interpret=True,
+                          pipeline=True)  # 8 rows < the 16-row strip
+
+
 # -- multi-step fusion (nsteps / substeps) -----------------------------------
 
 @pytest.mark.parametrize("shape,block,ns", [
