@@ -164,23 +164,26 @@ def validate_halo_on_device(substeps: int, dtype_name: str = "bfloat16",
         want = dense_flow_step_np(want, RATE)
     want = want[r0:r0 + h, c0:c0 + w]
 
-    for name, tol in _tols(substeps).items():
-        dtype = jnp.dtype(name)
-        shard = jnp.asarray(G[r0:r0 + h, c0:c0 + w], dtype)
-        ring = {k: jnp.asarray(v, dtype) for k, v in
-                ring_from_global_np(G, r0, c0, h, w, d).items()}
-        got = np.asarray(pallas_halo_step(
-            shard, ring, jnp.asarray([r0, c0], jnp.int32), G.shape, RATE,
-            interpret=False, nsteps=d), np.float64)
-        err = float(np.abs(got - want).max())
-        if err > tol:
-            raise AssertionError(
-                f"halo-mode on-device validation failed ({name}): "
-                f"max|err|={err:.3e} > {tol:.1e} vs the global oracle "
-                f"(shard origin ({r0},{c0}), depth {d})")
-        if verbose:
-            print(f"  halo gate OK ({name}): max|err|={err:.2e} "
-                  f"(origin ({r0},{c0}), depth {d})", file=sys.stderr)
+    # the BENCH dtype only: each dtype is a separate Mosaic compile, and
+    # the suite's silicon tests (test_pallas.py halo geometries) cover
+    # the other dtype's halo kernel — the gate's job is the timed config
+    tol = _tols(substeps).get(dtype_name, 0.04)
+    dtype = jnp.dtype(dtype_name)
+    shard = jnp.asarray(G[r0:r0 + h, c0:c0 + w], dtype)
+    ring = {k: jnp.asarray(v, dtype) for k, v in
+            ring_from_global_np(G, r0, c0, h, w, d).items()}
+    got = np.asarray(pallas_halo_step(
+        shard, ring, jnp.asarray([r0, c0], jnp.int32), G.shape, RATE,
+        interpret=False, nsteps=d), np.float64)
+    err = float(np.abs(got - want).max())
+    if err > tol:
+        raise AssertionError(
+            f"halo-mode on-device validation failed ({dtype_name}): "
+            f"max|err|={err:.3e} > {tol:.1e} vs the global oracle "
+            f"(shard origin ({r0},{c0}), depth {d})")
+    if verbose:
+        print(f"  halo gate OK ({dtype_name}): max|err|={err:.2e} "
+              f"(origin ({r0},{c0}), depth {d})", file=sys.stderr)
 
 
 def bench_halo_mode(space, model, dense_step, substeps: int,
