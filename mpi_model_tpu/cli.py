@@ -71,19 +71,22 @@ def _build_model(args):
     elif args.flow == "diffusion":
         flow = Diffusion(args.rate)
     elif args.flow == "coupled":
-        # the config-4 workload shape: N channels, each diffusing AND
-        # shedding mass modulated by the next channel (a coupling ring) —
-        # the multi-attribute case the fused FIELD kernel exists for
+        # the config-4 workload shape: N diffusing channels chained by
+        # coupled flows (channel i sheds mass modulated by channel i+1)
+        # — at --channels=2 this is the BASELINE config-4 flow SET
+        # (Diffusion(a) + Coupled(a|b) + Diffusion(b); the ladder's
+        # second diffusion uses rate 0.2 where the CLI applies --rate to
+        # every diffusion), the multi-attribute case the fused FIELD
+        # kernel exists for
         if args.channels < 2:
             raise SystemExit("--flow=coupled needs --channels >= 2 "
-                             "(a channel modulated by itself is just "
-                             "quadratic diffusion)")
+                             "(one channel has nothing to modulate — "
+                             "use --flow=diffusion)")
         names = [f"c{i}" for i in range(args.channels)]
-        flow = []
-        for i, nm in enumerate(names):
-            flow.append(Diffusion(args.rate, attr=nm))
-            flow.append(Coupled(flow_rate=args.rate / 2, attr=nm,
-                                modulator=names[(i + 1) % len(names)]))
+        flow = [Diffusion(args.rate, attr=nm) for nm in names]
+        flow += [Coupled(flow_rate=args.rate / 2, attr=names[i],
+                         modulator=names[i + 1])
+                 for i in range(len(names) - 1)]
         init_spec = {nm: args.init for nm in names}
     else:
         raise SystemExit(f"unknown --flow={args.flow!r} "
@@ -374,9 +377,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     run.add_argument("--flow", default="exponencial",
                      choices=["exponencial", "diffusion", "coupled"])
     run.add_argument("--channels", type=int, default=2,
-                     help="channel count for --flow=coupled (a ring of "
-                     "N diffusing channels, each modulated by the next "
-                     "— the config-4 multi-attribute workload)")
+                     help="channel count for --flow=coupled (a CHAIN of "
+                     "N diffusing channels, each but the last shedding "
+                     "mass modulated by the next — the config-4 "
+                     "multi-attribute workload shape)")
     run.add_argument("--source", default="19,3",
                      help="point-flow source cell x,y")
     run.add_argument("--rate", type=float, default=0.1)
