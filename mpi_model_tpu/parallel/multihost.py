@@ -334,6 +334,15 @@ if {phase} == 1:
                 if multihost.process_index() == {kill_rank}:
                     print("rank {kill_rank} dying mid-run", flush=True)
                     os._exit(17)
+                # survivors LINGER so the victim's death is what ends the
+                # job: jax's coordination service then aborts them (its
+                # failure propagation working as designed) or, if its
+                # reaction is slow, they stop cleanly — either way the
+                # rank-death teardown is the cluster runtime's, not a
+                # choreographed simultaneous exit (which raced the
+                # watchdog and could kill the victim before ITS exit)
+                import time as _t
+                _t.sleep(6.0)
                 print(f"survivor {{multihost.process_index()}} torn down",
                       flush=True)
                 os._exit(0)
@@ -426,18 +435,19 @@ def dryrun_supervised_kill(nprocs: int = 4, kill_rank: int = 2,
                 kill_rank=kill_rank, ckpt_dir=ckpt_dir, phase=phase)
                 for pid in range(nprocs)]
 
-        # phase 1: the crash run — victim must die 17, peers stop clean
+        # phase 1: the crash run. The victim's rc=17 proves the injection
+        # fired; the SURVIVORS' exit status is deliberately unasserted —
+        # they die however the cluster runtime reacts to a dead rank
+        # (jax's coordination service aborts them, or they reach their
+        # lingering clean stop first; both are legitimate teardowns and
+        # the choice is timing-dependent under load).
         outs = _launch_workers(codes(1, port), timeout, devices_per_proc=2)
-        for pid, (rc, out, err) in enumerate(outs):
-            want = 17 if pid == kill_rank else 0
-            if rc != want:
-                raise RuntimeError(
-                    f"phase-1 rank {pid}: rc={rc}, expected {want}:\n"
-                    f"{out[-2000:]}\n{err[-2000:]}")
-        if "dying mid-run" not in outs[kill_rank][1]:
+        rc_victim = outs[kill_rank][0]
+        if rc_victim != 17 or "dying mid-run" not in outs[kill_rank][1]:
             raise RuntimeError(
-                f"victim never reached the crash point: "
-                f"{outs[kill_rank][1]!r}")
+                f"victim rank {kill_rank}: rc={rc_victim}, expected 17 "
+                f"with the crash marker:\n{outs[kill_rank][1][-2000:]}\n"
+                f"{outs[kill_rank][2][-2000:]}")
 
         # phase 2: fresh cluster (new port), same checkpoint directory
         outs = _launch_workers(codes(2, port + 1), timeout,

@@ -95,3 +95,31 @@ def test_ladder_config3_quick_has_gspmd_row():
 
     row = L.config3(quick=True)
     assert "gspmd_cups" in row and "gspmd_vs_shardmap" in row
+
+
+def test_timing_trial_helpers():
+    """The trial/median helpers the bench discipline rests on: shapes,
+    medians, and the interleaved A/B structure (pure-CPU smoke)."""
+    import jax.numpy as jnp
+
+    from mpi_model_tpu.utils import (interleaved_ab, marginal_runner_trials,
+                                     marginal_step_trials, median_spread)
+
+    ms = median_spread([3.0, 1.0, 2.0])
+    assert ms == {"value": 2.0, "spread_lo": 1.0, "spread_hi": 3.0}
+
+    calls = []
+    ts = marginal_runner_trials(lambda n: calls.append(n), s1=1, s2=2,
+                                trials=3)
+    assert len(ts) == 3 and calls == [1, 2] * 3  # back-to-back per trial
+
+    v0 = {"value": jnp.ones((4, 4), jnp.float32)}
+
+    def step(vals):
+        return {"value": vals["value"] * 0.5}
+
+    samples = marginal_step_trials(step, v0, s1=1, s2=3, trials=2)
+    assert len(samples) == 2
+
+    med = interleaved_ab({"a": step, "b": step}, v0, s1=1, s2=2, reps=2)
+    assert set(med) == {"a", "b"}

@@ -45,7 +45,18 @@ def test_four_process_kill_and_resume():
     rank 2 dies hard after computing steps past the last commit (that
     work is lost); a fresh 4-process cluster resumes the directory and
     completes — BITWISE equal to an uninterrupted run, conserving."""
-    line = multihost.dryrun_supervised_kill(nprocs=4, port=29871)
+    import subprocess
+
+    try:
+        line = multihost.dryrun_supervised_kill(nprocs=4, port=29871,
+                                                timeout=420)
+    except (RuntimeError, subprocess.TimeoutExpired):
+        # one retry on a fresh coordinator port: the suite occasionally
+        # leaves the previous port in TIME_WAIT / the loaded rig misses
+        # the window (observed once across many runs); a genuine
+        # kill/resume defect fails both attempts
+        line = multihost.dryrun_supervised_kill(nprocs=4, port=29931,
+                                                timeout=420)
     assert "MASTER ok: procs=4" in line
     assert "resumed_from=4" in line          # step-6 work died uncommitted
     assert "final_step=10" in line
