@@ -48,6 +48,15 @@ full config-5 distributed step with the collective topology degenerate —
 reported as ``halo_step_ms`` / ``halo_overhead_pct`` vs the dense
 kernel (the dense-vs-halo-mode overhead row, round-4 VERDICT task 1).
 
+When the headline resolves to the Pallas kernel, the row also carries
+the COMPOSED-FILTER rows (``bench_composed`` — ISSUE 1): each candidate
+(k, variant) advances k flow steps as ONE (2k+1)²-tap pass (VPU
+binomial lowering; MXU banded contraction at >= 9 taps), oracle-gated
+at 1536² and at the timed geometry (including the conservation
+contract) before timing, median+spread per row — the measured answer to
+whether composition breaks the round-5 radius-1 VPU ceiling, or the
+bounded null BASELINE.md's slot accounting predicts.
+
 The full config ladder lives in benchmarks/ladder.py; this file is the
 driver's single-number entry point.
 """
@@ -80,6 +89,34 @@ def enable_compile_cache() -> None:
 
 def _tols(substeps: int) -> dict:
     return {"float32": 1e-5 * max(1, substeps), "bfloat16": 0.04}
+
+
+def _tol_for(substeps: int, dtype) -> float:
+    """Oracle tolerance for a bench gate, keyed by dtype — a clear error
+    for spaces the gates have no tolerance tier for, instead of the bare
+    ``KeyError`` a non-f32/bf16 dtype used to raise mid-gate."""
+    import jax.numpy as jnp
+
+    tols = _tols(substeps)
+    key = str(jnp.dtype(dtype))
+    if key not in tols:
+        raise ValueError(
+            f"bench gates have no oracle tolerance for dtype {key!r}; "
+            f"supported: {sorted(tols)} (the Pallas kernels compute in "
+            "f32 internally, so other dtypes have no calibrated tier)")
+    return tols[key]
+
+
+def _cups_spread(samples: list, cells: float) -> dict:
+    """cups spread implied by the POSITIVE marginal samples — a
+    transient can make an individual marginal estimate non-positive
+    even when the median is sound, and such samples carry no spread
+    information (a negative per-step time inverts into a negative cups
+    bound). Null fields when none survive (the halo row's med<=0
+    discipline)."""
+    pos = [s for s in samples if s > 0]
+    return {"spread_lo": cells / max(pos) if pos else None,
+            "spread_hi": cells / min(pos) if pos else None}
 
 
 def _max_err(a, b) -> float:
@@ -186,6 +223,158 @@ def validate_halo_on_device(substeps: int, dtype_name: str = "bfloat16",
               f"(origin ({r0},{c0}), depth {d})", file=sys.stderr)
 
 
+def validate_composed_on_device(k: int, variant: str,
+                                dtype_name: str = "bfloat16",
+                                verbose: bool = False) -> None:
+    """Golden-check one composed-filter configuration on the bench
+    device against k iterated oracle steps, at 1536² (3x3 tiles at the
+    default block: genuine interior tiles run the tap/contraction path,
+    the perimeter tiles the exact iterated near band). Same discipline
+    as ``validate_on_device``; raises on an oracle mismatch."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_model_tpu.oracle import dense_flow_step_np
+    from mpi_model_tpu.ops.composed_stencil import composed_dense_step
+
+    rng = np.random.default_rng(33)
+    g = 1536
+    v0 = rng.uniform(0.5, 2.0, (g, g)).astype(np.float32)
+    want = v0.astype(np.float64)
+    for _ in range(k):
+        want = dense_flow_step_np(want, RATE)
+    tol = _tol_for(k, dtype_name)
+    dtype = jnp.dtype(dtype_name)
+    got = np.asarray(composed_dense_step(
+        jnp.asarray(v0, dtype), RATE, k, interpret=False,
+        variant=variant), np.float64)
+    err = float(np.abs(got - want).max())
+    if err > tol:
+        raise AssertionError(
+            f"composed on-device validation failed ({dtype_name}, k={k}, "
+            f"{variant}): max|err|={err:.3e} > {tol:.1e} vs {k} iterated "
+            "oracle steps")
+    if verbose:
+        print(f"  composed gate OK (k={k} {variant} {dtype_name}): "
+              f"max|err|={err:.2e}", file=sys.stderr)
+
+
+def bench_composed(space, model, dense_step, substeps: int,
+                   trials: int = 5, verbose: bool = False) -> dict:
+    """The composed-filter config-5 rows (ISSUE 1 tentpole): each row
+    times a ``ComposedDiffusionStep`` whose ONE call advances k flow
+    steps as a single (2k+1)²-tap pass — k = substeps (one pass per
+    fused chunk, the headline's geometry) and k = 2·substeps (deeper
+    composition), each in the VPU binomial lowering and, at >= 9 taps,
+    the MXU banded-contraction lowering. Every row is oracle-gated at
+    1536² AND at the timed geometry (vs the already-gated dense step,
+    plus the conservation contract) before any timing; rows report
+    median+spread of ``trials`` marginal estimates — the same
+    discipline as the pallas headline. A row whose gate fails aborts;
+    a row whose kernel can't build on this geometry is reported with an
+    honest ``error`` marker instead of silently vanishing."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_model_tpu.ops.composed_stencil import (ComposedDiffusionStep,
+                                                    max_k)
+    from mpi_model_tpu.utils import marginal_step_trials
+
+    grid = space.shape[0]
+    dtype_name = str(space.dtype)
+    cap = max_k(space.shape, space.dtype)
+    cands = []
+    rows = []
+    for k in (substeps, 2 * substeps):
+        if k > cap:
+            # honest marker, not a silent drop: a driver must be able to
+            # tell "ineligible on this geometry" from "never ran"
+            rows.append({"k": k, "taps": 2 * k + 1,
+                         "error": f"k={k} exceeds the window ghost "
+                                  f"depth {cap} for {dtype_name}"})
+            continue
+        cands.append((k, "vpu"))
+        if 2 * k + 1 >= 9:
+            cands.append((k, "mxu"))
+    # the timed-geometry reference: substeps iterated steps of the
+    # suite-oracle-tested dense step (one call = substeps steps)
+    base = dense_step(dict(space.values))["value"]
+    base_total = float(jnp.sum(base.astype(jnp.float32)))
+    init_total = float(jnp.sum(
+        space.values["value"].astype(jnp.float32)))
+    thresh = model.conservation_threshold(space)
+    for k, variant in cands:
+        row = {"k": k, "taps": 2 * k + 1, "variant": variant}
+        try:
+            validate_composed_on_device(k, variant, dtype_name,
+                                        verbose=verbose)
+            stepper = ComposedDiffusionStep(space.shape, RATE, k,
+                                            dtype=space.dtype,
+                                            variant=variant)
+
+            def step(vals, _s=stepper):
+                return {"value": _s(vals["value"])}
+
+            # timed-geometry gate: one composed pass vs the dense
+            # kernel advanced the same k steps (both compute f32
+            # interiors; bf16 storage rounding bounds the difference),
+            # plus the conservation contract at the timed size
+            out = step(dict(space.values))["value"]
+            want = base
+            for _ in range((k // substeps) - 1):
+                want = dense_step({"value": want})["value"]
+            err = _max_err(out, want)
+            tol = _tol_for(k, space.dtype)
+            if err > tol:
+                raise AssertionError(
+                    f"composed bench-geometry gate failed at {grid}^2 "
+                    f"(k={k}, {variant}): max|err|={err:.3e} > {tol:.1e}")
+            total = float(jnp.sum(out.astype(jnp.float32)))
+            # the bound allows the dense baseline's own storage-rounding
+            # drift at this size (bf16 sums at 16384² exceed the model
+            # threshold without any kernel defect)
+            bound = max(thresh, abs(base_total - init_total))
+            if abs(total - init_total) > bound:
+                raise AssertionError(
+                    f"composed conservation gate failed at {grid}^2 "
+                    f"(k={k}, {variant}): |Δtotal|="
+                    f"{abs(total - init_total):.3e} > {bound:.3e}")
+            samples = marginal_step_trials(step, dict(space.values),
+                                           s1=10, s2=60, trials=trials)
+            med = statistics.median(samples)
+            if med <= 0:
+                row.update({"step_ms": None, "cups": None,
+                            "error": "pure noise"})
+            else:
+                row.update({
+                    "step_ms": med * 1e3 / k,
+                    "cups": grid * grid * k / med,
+                    "trials": trials,
+                    **_cups_spread(samples, grid * grid * k),
+                })
+            if verbose and row.get("cups"):
+                print(f"  composed k={k} {variant}: "
+                      f"{row['step_ms']:.3f} ms/step "
+                      f"({row['cups']:.3e} cups)", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — per-row honesty
+            row["error"] = str(e)[:300]
+            if verbose:
+                print(f"  composed k={k} {variant} FAILED: {e}",
+                      file=sys.stderr)
+        rows.append(row)
+    ok = [r for r in rows if r.get("cups")]
+    best = max(ok, key=lambda r: r["cups"]) if ok else None
+    return {
+        "composed_rows": rows,
+        "composed_best_cups": best["cups"] if best else None,
+        "composed_best": ({"k": best["k"], "variant": best["variant"]}
+                          if best else None),
+    }
+
+
 def bench_halo_mode(space, model, dense_step, substeps: int,
                     trials: int = 3, verbose: bool = False) -> dict:
     """Time the full sharded architecture on a 1-device TPU mesh: the
@@ -215,7 +404,7 @@ def bench_halo_mode(space, model, dense_step, substeps: int,
     # ~2GB each
     want = dense_step(dict(space.values))
     err = _max_err(out["value"], want["value"])
-    tol = _tols(substeps)[str(space.dtype)]
+    tol = _tol_for(substeps, space.dtype)
     if err > tol:
         raise AssertionError(
             f"halo-mode bench gate failed at {space.shape}: "
@@ -242,7 +431,7 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
     import numpy as np
 
     from mpi_model_tpu import CellularSpace, Diffusion, Model
-    from mpi_model_tpu.utils import marginal_step_trials, median_spread
+    from mpi_model_tpu.utils import marginal_step_trials
 
     if dtype_name not in ("float32", "bfloat16"):
         # fail BEFORE any on-device work: the geometry/halo gates index
@@ -292,7 +481,7 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
         for _ in range(substeps):
             want = xla_step(want)
         err = _max_err(got["value"], want["value"])
-        tol = _tols(substeps)[dtype_name]
+        tol = _tol_for(substeps, dtype_name)
         if err > tol:
             raise AssertionError(
                 f"bench-geometry gate failed at {grid}^2: "
@@ -301,10 +490,15 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
             print(f"  bench-geometry gate OK: max|err|={err:.2e}",
                   file=sys.stderr)
 
+    import statistics
+
     samples = marginal_step_trials(step, dict(space.values),
                                    s1=10, s2=60, trials=trials)
-    ms = median_spread(samples)
-    t = ms["value"]
+    t = statistics.median(samples)
+    if t <= 0:
+        raise AssertionError(
+            f"marginal medians drowned in tunnel noise (median "
+            f"{t:.3e}s <= 0 across {trials} trials); re-run the bench")
 
     halo = bench_halo_mode(space, model, step, substeps, verbose=verbose)
     if halo.get("halo_step_ms"):
@@ -312,11 +506,21 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
             100.0 * (halo["halo_step_ms"] / (t * 1e3 / substeps) - 1.0), 1)
 
     cups = grid * grid * substeps / t
+    # the composed-filter rows (the radius-1-ceiling avenue): only
+    # meaningful against a Pallas headline — an XLA fallback run has no
+    # kernel ceiling to compare to
+    composed: dict = {}
+    if impl_used == "pallas":
+        composed = bench_composed(space, model, step, substeps,
+                                  trials=trials, verbose=verbose)
+        if composed.get("composed_best_cups"):
+            composed["composed_speedup"] = round(
+                composed["composed_best_cups"] / cups, 3)
     if verbose:
         print(f"  impl={impl_used}: {t*1000/substeps:.3f} ms/step "
               f"median of {trials} trials "
-              f"(spread {ms['spread_lo']*1e3/substeps:.3f}-"
-              f"{ms['spread_hi']*1e3/substeps:.3f})", file=sys.stderr)
+              f"(samples {min(samples)*1e3/substeps:.3f}-"
+              f"{max(samples)*1e3/substeps:.3f} ms)", file=sys.stderr)
     # roofline accounting: place the number against this chip's ceilings,
     # not just the 1e9 north star. The substeps-amortized traffic model
     # only holds for the fused Pallas kernel; the XLA fallback does one
@@ -338,12 +542,13 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
         "substeps": substeps,
         "trials": trials,
         "step_ms": t * 1e3 / substeps,
-        # spread of the per-trial cups implied by the marginal estimates:
-        # successive driver rounds should compare medians within spread,
-        # not read tunnel noise as a regression
-        "spread_lo": grid * grid * substeps / ms["spread_hi"],
-        "spread_hi": grid * grid * substeps / ms["spread_lo"],
+        # spread of the per-trial cups implied by the marginal estimates
+        # (noise-filtered, _cups_spread): successive driver rounds
+        # should compare medians within spread, not read tunnel noise
+        # as a regression
+        **_cups_spread(samples, grid * grid * substeps),
         **halo,
+        **composed,
         **roof,
     }
 

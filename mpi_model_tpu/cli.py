@@ -190,10 +190,10 @@ def cmd_run(args) -> int:
         if args.mesh is None:
             raise SystemExit("--executor=gspmd is a sharded path; add "
                              "--mesh=LxC")
-        if args.impl == "pallas":
+        if args.impl in ("pallas", "composed"):
             raise SystemExit(
                 "--executor=gspmd runs the global XLA step (XLA inserts "
-                "the collectives); the Pallas halo kernels need "
+                "the collectives); the Pallas/composed kernels need "
                 "--executor=shardmap")
         if args.halo_depth != 1 or args.compute_dtype is not None:
             raise SystemExit(
@@ -394,7 +394,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     run.add_argument("--dtype", default="float32",
                      choices=["float32", "float64", "bfloat16"])
     run.add_argument("--impl", default="auto",
-                     choices=["xla", "pallas", "auto"])
+                     choices=["xla", "pallas", "auto", "composed"],
+                     help="field-flow kernel: 'composed' runs the "
+                     "k-step composed tap filter (uniform-rate "
+                     "Diffusion only; pair with --substeps=k serially "
+                     "or --halo-depth=k sharded)")
     run.add_argument("--compute-dtype", default=None,
                      choices=["float32", "bfloat16"],
                      help="Pallas interior-tile math dtype (default f32; "

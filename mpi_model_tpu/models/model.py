@@ -252,10 +252,15 @@ class Model:
         works for every flow), ``"pallas"`` (the fused one-HBM-pass TPU
         kernel, ``ops.pallas_stencil`` — requires all field flows to be
         plain ``Diffusion`` on a full non-partition grid; raises
-        ``ValueError`` otherwise), or ``"auto"`` (pallas when eligible
-        AND its compile succeeds — a trace/lowering/compile failure falls
-        back to xla instead of propagating). The returned step carries
-        ``.impl`` naming the kernel actually in use.
+        ``ValueError`` otherwise), ``"composed"`` (the composed k-step
+        filter, ``ops.composed_stencil`` — same eligibility as pallas
+        Diffusion; k is auto-chosen as the largest window-composable
+        divisor of ``substeps`` and each compiled call runs
+        ``substeps/k`` single-pass composed filters), or ``"auto"``
+        (pallas when eligible AND its compile succeeds — a
+        trace/lowering/compile failure falls back to xla instead of
+        propagating). The returned step carries ``.impl`` naming the
+        kernel actually in use.
 
         ``substeps > 1`` returns a step that advances the model that many
         steps per call. On the Pallas path the steps are fused INSIDE the
@@ -275,7 +280,7 @@ class Model:
             raise TypeError(
                 f"flow transport requires a floating dtype, got {space.dtype}"
                 " (integer channels are supported for storage/comm, not flows)")
-        if impl not in ("xla", "pallas", "auto"):
+        if impl not in ("xla", "pallas", "auto", "composed"):
             raise ValueError(f"unknown step impl {impl!r}")
         substeps = int(substeps)
         if substeps < 1:
@@ -302,6 +307,51 @@ class Model:
 
         pallas_steppers = None
         pallas_field_stepper = None
+        composed_steppers = None
+        composed_passes = 1
+        if impl == "composed":
+            # composed k-step filter (ops.composed_stencil): one
+            # (2k+1)² tap pass per k steps of a uniform-rate
+            # (all-Diffusion) model — the radius-1-ceiling breaker
+            # named by the round-5 roofline investigation. k is
+            # auto-chosen as the largest window-composable divisor of
+            # ``substeps``, so one compiled call runs ``substeps/k``
+            # composed passes with no remainder step.
+            rates = self.pallas_rates()
+            if rates is not None and not any(r != 0.0
+                                             for r in rates.values()):
+                raise ValueError(
+                    "impl='composed' has nothing to compose: every "
+                    "Diffusion rate is 0.0 (no field transport). Use "
+                    "impl='xla'/'auto' for a no-op field step.")
+            eligible = (bool(rates) and not space.is_partition
+                        and self.pallas_dtype_ok(space)
+                        and (substeps == 1 or not pt_by_attr))
+            if not eligible:
+                raise ValueError(
+                    "impl='composed' requires all field flows to be plain "
+                    "Diffusion (a uniform rate is what composes into an "
+                    "explicit tap table) on a full (non-partition) "
+                    "f32/bf16 grid, with no point flows when "
+                    "substeps > 1; got "
+                    f"flows={[type(f).__name__ for f in self.flows]}, "
+                    f"is_partition={space.is_partition}, "
+                    f"dtype={space.dtype}, substeps={substeps}. Use "
+                    "impl='xla'/'auto', or ShardMapExecutor("
+                    "step_impl='composed', halo_depth=k) for sharded "
+                    "runs.")
+            from ..ops.composed_stencil import (ComposedDiffusionStep,
+                                               choose_k)
+            from ..ops.pallas_stencil import resolve_interpret
+            interp = resolve_interpret(next(iter(space.values.values())))
+            ck = choose_k(substeps, space.shape, space.dtype)
+            composed_passes = substeps // ck
+            composed_steppers = {
+                attr: ComposedDiffusionStep(
+                    space.shape, rate, ck, dtype=space.dtype,
+                    offsets=offsets, interpret=interp,
+                    compute_dtype=compute_dtype)
+                for attr, rate in rates.items() if rate != 0.0}
         if impl in ("pallas", "auto"):
             rates = self.pallas_rates()
             all_pointwise = all(
@@ -405,7 +455,16 @@ class Model:
             # into the compiled program (256MB at 8192² f32)
             counts = neighbor_counts_traced(shape, offsets, origin, gshape,
                                             space.dtype)
-            if pallas_steppers is not None:
+            if composed_steppers is not None:
+                # substeps/k composed passes per call (each pass = k
+                # flow steps in one kernel invocation); eligibility
+                # guaranteed no point flows interleave when substeps > 1
+                for attr, stepper in composed_steppers.items():
+                    cur = values[attr]
+                    for _ in range(composed_passes):
+                        cur = stepper(cur)
+                    new[attr] = cur
+            elif pallas_steppers is not None:
                 # with substeps > 1, each stepper advances ALL the
                 # sub-steps inside the kernel (and eligibility guaranteed
                 # there are no point flows to interleave)
@@ -429,7 +488,8 @@ class Model:
             return new
 
         if (substeps == 1 or pallas_steppers is not None
-                or pallas_field_stepper is not None):
+                or pallas_field_stepper is not None
+                or composed_steppers is not None):
             step = single
         else:
             def step(values: Values) -> Values:
@@ -439,8 +499,9 @@ class Model:
 
         # which field-flow kernel the step actually uses (after any auto
         # fallback) — callers like bench report it
-        step.impl = ("pallas" if (pallas_steppers is not None
-                                  or pallas_field_stepper is not None)
+        step.impl = ("composed" if composed_steppers is not None
+                     else "pallas" if (pallas_steppers is not None
+                                       or pallas_field_stepper is not None)
                      else "xla")
         step.substeps = substeps
         self._step_cache[key] = step

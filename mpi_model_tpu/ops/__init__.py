@@ -1,3 +1,10 @@
+from .composed_stencil import (
+    ComposedDiffusionStep,
+    choose_k,
+    composed_dense_step,
+    composed_halo_step,
+    composed_taps,
+)
 from .flow import Coupled, Diffusion, Exponencial, Flow, PointFlow, build_outflow
 from .pallas_stencil import (
     PallasDiffusionStep,
@@ -24,4 +31,9 @@ __all__ = [
     "pallas_field_halo_step",
     "PallasDiffusionStep",
     "PallasFieldStep",
+    "ComposedDiffusionStep",
+    "composed_dense_step",
+    "composed_halo_step",
+    "composed_taps",
+    "choose_k",
 ]

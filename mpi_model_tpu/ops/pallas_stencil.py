@@ -48,6 +48,7 @@ import numpy as np
 from jax import lax
 
 from ..core.cell import MOORE_OFFSETS
+from ..compat import HBM as _HBM, tpu_compiler_params
 
 LANE = 128  # TPU lane tile (last dim)
 
@@ -90,7 +91,8 @@ def check_offsets(offsets: Sequence[tuple[int, int]]) -> tuple:
 
 
 def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
-                  global_shape, nsteps=1, compute_dtype=jnp.float32):
+                  global_shape, nsteps=1, compute_dtype=jnp.float32,
+                  interior_fn=None):
     """Build and invoke the fused-stencil ``pallas_call``.
 
     Two modes share the window/pipeline machinery:
@@ -110,6 +112,16 @@ def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
       composes with ``shard_map``'s ppermute ring (SURVEY §7 "Pallas at
       16384^2"): ppermute's zero-fill at true grid edges reproduces
       exactly the zero border the dense kernel builds for itself.
+
+    ``interior_fn`` (the composed-filter hook, ``ops.composed_stencil``):
+    replaces the interior tiles' iterated update with one call mapping
+    the ``(bh + 2*nsteps, bw + 2*nsteps)`` window region (already cast
+    to ``compute_dtype``) to the ``(bh, bw)`` output — e.g. a single
+    pass of the ``nsteps``-fold-composed ``(2*nsteps+1)²`` tap filter.
+    The near-boundary band (tiles whose influence region touches the
+    global ring, where divisor corrections make the operator spatially
+    varying) ALWAYS runs the exact iterated masked path regardless of
+    the hook, so boundary semantics are hook-independent.
 
     ``nsteps > 1`` (dense mode only): the Mosaic-alignment over-fetch
     means the window already holds an ``hr``-row / ``hc``-column halo
@@ -353,8 +365,8 @@ def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
             g_r0 = r0
             g_c0 = c0
 
-        if nsteps > 1:
-            # ---- multi-step fused path (dense mode only) ----
+        if nsteps > 1 or interior_fn is not None:
+            # ---- multi-step fused path (dense + halo modes) ----
             # The DMA-aligned window carries an hr-row / hc-column halo;
             # only an nsteps-deep ring of it is ever consumed, so the
             # compute region is first NARROWED to (bh+2n, bw+2n) — the
@@ -381,6 +393,12 @@ def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
 
             @pl.when(jnp.logical_not(near))
             def _():
+                if interior_fn is not None:
+                    # composed-filter hook: one pass of the k-fold
+                    # filter over the window region IS the k steps
+                    out_ref[...] = interior_fn(mwin()).astype(
+                        out_ref.dtype)
+                    return
                 cur = mwin()
                 for _ in range(nsteps):
                     hs, ws = cur.shape
@@ -494,7 +512,7 @@ def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
         # pinned to HBM: DMA offsets into HBM are unconstrained, and
         # ANY would let the compiler pick VMEM for small grids,
         # re-imposing the (SUB, LANE) slice alignment on the source
-        pl.BlockSpec(memory_space=pltpu.HBM),
+        pl.BlockSpec(memory_space=_HBM),
     ]
     if halo:
         nslab, sslab, wfull, efull, origin = halo_operands
@@ -502,7 +520,7 @@ def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
         # the SMEM spec needs an EXPLICIT int32 index map: the default
         # one returns literal zeros, which trace to i64 under
         # jax_enable_x64 and fail Mosaic verification (func.return i64)
-        in_specs = ([pl.BlockSpec(memory_space=pltpu.HBM)] * 5
+        in_specs = ([pl.BlockSpec(memory_space=_HBM)] * 5
                     + [pl.BlockSpec((2,), lambda i, j: (np.int32(0),),
                                     memory_space=pltpu.SMEM)])
     return pl.pallas_call(
@@ -518,7 +536,7 @@ def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
         # double-buffered windows + f32 temporaries overflow the default
         # 16MB scoped-VMEM budget at the fastest block sizes; v5e has
         # 128MB physical VMEM
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(*operands)
@@ -526,15 +544,17 @@ def _stencil_call(v, halo_operands, *, rate, block, offsets, interpret,
 
 @functools.partial(jax.jit,
                    static_argnames=("rate", "block", "offsets", "interpret",
-                                    "nsteps", "compute_dtype"))
+                                    "nsteps", "compute_dtype",
+                                    "interior_fn"))
 def _pallas_step(v: jax.Array, *, rate: float,
                  block: tuple[int, int],
                  offsets: tuple[tuple[int, int], ...],
                  interpret: bool, nsteps: int = 1,
-                 compute_dtype=jnp.float32) -> jax.Array:
+                 compute_dtype=jnp.float32, interior_fn=None) -> jax.Array:
     return _stencil_call(v, None, rate=rate, block=block, offsets=offsets,
                          interpret=interpret, global_shape=None,
-                         nsteps=nsteps, compute_dtype=compute_dtype)
+                         nsteps=nsteps, compute_dtype=compute_dtype,
+                         interior_fn=interior_fn)
 
 
 # -- pipelined dense kernel (nine Blocked specs, no manual DMA) --------------
@@ -711,7 +731,7 @@ def _pipeline_call(v, *, rate, block, offsets, interpret, nsteps,
         in_specs=specs,
         out_specs=pl.BlockSpec((bh, bw), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((h, w), v.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=110 * 1024 * 1024),
         interpret=interpret,
     )(*([v] * 9))
@@ -733,14 +753,15 @@ def _pallas_pipeline_step(v: jax.Array, *, rate: float,
 @functools.partial(jax.jit,
                    static_argnames=("rate", "block", "offsets", "interpret",
                                     "global_shape", "nsteps",
-                                    "compute_dtype"))
+                                    "compute_dtype", "interior_fn"))
 def _pallas_halo_step(v, n, s, w_col, e_col, nw, ne, sw, se, origin, *,
                       rate: float, block: tuple[int, int],
                       offsets: tuple[tuple[int, int], ...],
                       interpret: bool,
                       global_shape: tuple[int, int],
                       nsteps: int = 1,
-                      compute_dtype=jnp.float32) -> jax.Array:
+                      compute_dtype=jnp.float32,
+                      interior_fn=None) -> jax.Array:
     """Assemble the raw depth-d ghost ring into piece-granularity slabs
     and run the halo-mode kernel (see ``_stencil_call``). The ring depth
     d = n.shape[0]; ghost cells sit INNERMOST in each slab (adjacent to
@@ -770,7 +791,8 @@ def _pallas_halo_step(v, n, s, w_col, e_col, nw, ne, sw, se, origin, *,
     return _stencil_call(v, (nslab, sslab, wfull, efull, origin),
                          rate=rate, block=block, offsets=offsets,
                          interpret=interpret, global_shape=global_shape,
-                         nsteps=nsteps, compute_dtype=compute_dtype)
+                         nsteps=nsteps, compute_dtype=compute_dtype,
+                         interior_fn=interior_fn)
 
 
 def pallas_halo_step(
@@ -784,6 +806,7 @@ def pallas_halo_step(
     interpret: Optional[bool] = None,
     nsteps: int = 1,
     compute_dtype=None,
+    interior_fn=None,
 ) -> jax.Array:
     """Per-shard fused flow step(s) consuming a ppermute ghost ring.
 
@@ -798,7 +821,9 @@ def pallas_halo_step(
     per d steps, the full config-5 architecture. Semantics:
     ``pallas_dense_step`` on the global grid, computed shard-locally —
     the sharded realization of the reference's cross-rank halo update
-    (``/root/reference/src/Model.hpp:189-235``).
+    (``/root/reference/src/Model.hpp:189-235``). ``interior_fn`` is the
+    composed-filter interior hook (see ``_stencil_call``); near-boundary
+    tiles keep the exact iterated path either way.
     """
     offsets = check_offsets(offsets)
     h, w = values.shape
@@ -827,7 +852,8 @@ def pallas_halo_step(
         rate=float(rate), block=tuple(block), offsets=offsets,
         interpret=bool(interpret), global_shape=tuple(global_shape),
         nsteps=int(nsteps),
-        compute_dtype=jnp.dtype(compute_dtype or jnp.float32))
+        compute_dtype=jnp.dtype(compute_dtype or jnp.float32),
+        interior_fn=interior_fn)
 
 
 def mesh_interpret(mesh) -> bool:
@@ -892,6 +918,7 @@ def pallas_dense_step(
     nsteps: int = 1,
     compute_dtype=None,
     pipeline: Optional[bool] = None,
+    interior_fn=None,
 ) -> jax.Array:
     """``nsteps`` fused dense flow steps in one HBM round-trip: every
     cell sheds ``rate * value`` split equally among its in-bounds
@@ -909,10 +936,18 @@ def pallas_dense_step(
     each step reads the buffer the previous step just wrote — measured
     both ways at 16384² bf16 x4 with interleaved medians (round-5
     roofline investigation, BASELINE.md). Kept as a correct, tested
-    alternative for workloads with the favorable dispatch pattern."""
+    alternative for workloads with the favorable dispatch pattern.
+
+    ``interior_fn`` is the composed-filter interior hook (see
+    ``_stencil_call``; built by ``ops.composed_stencil``) — it replaces
+    the interior tiles' iterated update with one composed-filter pass;
+    incompatible with ``pipeline=True``."""
     offsets = check_offsets(offsets)
     if nsteps < 1:
         raise ValueError(f"nsteps must be >= 1, got {nsteps}")
+    if pipeline and interior_fn is not None:
+        raise ValueError("interior_fn is not supported by the pipelined "
+                         "window kernel; use pipeline=False")
     h, w = values.shape
     if interpret is None:
         interpret = resolve_interpret(values)
@@ -951,7 +986,8 @@ def pallas_dense_step(
     return _pallas_step(values, rate=float(rate),
                         block=tuple(block), offsets=offsets,
                         interpret=bool(interpret), nsteps=int(nsteps),
-                        compute_dtype=jnp.dtype(compute_dtype))
+                        compute_dtype=jnp.dtype(compute_dtype),
+                        interior_fn=interior_fn)
 
 
 class PallasDiffusionStep:
@@ -1325,12 +1361,12 @@ def _field_call(chans, names, flows, *, block, offsets, interpret, nsteps,
             write_out(cur)
 
     operands = list(chans)
-    in_specs = [pl.BlockSpec(memory_space=pltpu.HBM)] * C
+    in_specs = [pl.BlockSpec(memory_space=_HBM)] * C
     if halo:
         slabs, origin = halo_operands
         operands += list(slabs) + [origin]
         # explicit int32 index map for SMEM (see _stencil_call)
-        in_specs += ([pl.BlockSpec(memory_space=pltpu.HBM)] * (4 * C)
+        in_specs += ([pl.BlockSpec(memory_space=_HBM)] * (4 * C)
                      + [pl.BlockSpec((2,), lambda i, j: (np.int32(0),),
                                      memory_space=pltpu.SMEM)])
     return pl.pallas_call(
@@ -1343,7 +1379,7 @@ def _field_call(chans, names, flows, *, block, offsets, interpret, nsteps,
             pltpu.VMEM((C, 2, wh, ww), dtype),
             pltpu.SemaphoreType.DMA((2, C, n_pieces)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=96 * 1024 * 1024),
         interpret=interpret,
     )(*operands)

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat import shard_map
 from ..core.cellular_space import CellularSpace
 from ..ops.flow import PointFlow
 from .halo import gather_from_padded, pad_with_halo_1d, pad_with_halo_2d
@@ -152,9 +153,13 @@ class ShardMapExecutor:
     ppermute ghost ring — the specialized Diffusion kernel when every
     flow is a plain ``Diffusion``, else the general multi-channel field
     kernel for any POINTWISE flows (Coupled/user); requires no point
-    flows and an f32/bf16 non-partition grid, raises otherwise), or
-    ``"auto"`` (pallas when eligible and its compile succeeds, else
-    xla).
+    flows and an f32/bf16 non-partition grid, raises otherwise),
+    ``"composed"`` (the composed k-step filter consuming the
+    ``halo_depth``-deep ring: interior tiles run ONE
+    ``(2·halo_depth+1)²`` tap pass per exchange instead of
+    ``halo_depth`` iterated steps — all-Diffusion models only, raises
+    otherwise; see ``ops.composed_stencil``), or ``"auto"`` (pallas
+    when eligible and its compile succeeds, else xla).
     """
 
     def __init__(self, mesh: Mesh, step_impl: str = "xla",
@@ -162,7 +167,7 @@ class ShardMapExecutor:
                  compute_dtype=None):
         if len(mesh.axis_names) not in (1, 2):
             raise ValueError("ShardMapExecutor needs a 1-D or 2-D mesh")
-        if step_impl not in ("xla", "pallas", "auto"):
+        if step_impl not in ("xla", "pallas", "auto", "composed"):
             raise ValueError(f"unknown step impl {step_impl!r}")
         if halo_mode not in ("exchange", "zero"):
             raise ValueError(f"unknown halo mode {halo_mode!r}")
@@ -204,10 +209,14 @@ class ShardMapExecutor:
     def _pallas_plan(self, model, space: CellularSpace):
         """Which fused halo kernel applies: ``("diffusion", rates)`` when
         every flow is a plain Diffusion (the specialized kernel with the
-        closed-form interior fast path), ``("field", flows)`` when every
+        closed-form interior fast path), ``("composed", rates)`` for the
+        same shape under ``step_impl="composed"`` (interior tiles run
+        ONE composed (2·depth+1)² tap pass per depth-deep exchange,
+        ``ops.composed_stencil``), ``("field", flows)`` when every
         field flow is pointwise (the general multi-channel kernel —
         Coupled/user flows), or None → the XLA shard step. Raises for an
-        explicit ``step_impl='pallas'`` that can't be honored."""
+        explicit ``step_impl='pallas'``/``'composed'`` that can't be
+        honored."""
         if self.step_impl == "xla":
             return None
         has_point = any(isinstance(f, PointFlow) for f in model.flows)
@@ -217,6 +226,24 @@ class ShardMapExecutor:
         # cannot interleave
         base_ok = (not has_point and not space.is_partition
                    and model.pallas_dtype_ok(space))
+        if self.step_impl == "composed":
+            rates = model.pallas_rates() if base_ok else None
+            if rates and any(r != 0.0 for r in rates.values()):
+                return ("composed", rates)
+            if rates is not None and not any(r != 0.0
+                                             for r in rates.values()):
+                raise ValueError(
+                    "step_impl='composed' has nothing to compose: every "
+                    "Diffusion rate is 0.0 (no field transport). Use "
+                    "'xla' or 'auto' for a no-op field step.")
+            raise ValueError(
+                "step_impl='composed' requires all field flows to be "
+                "plain Diffusion (a uniform rate is what composes into "
+                "an explicit tap table) on a full (non-partition) "
+                "f32/bf16 grid with no point flows; got "
+                f"flows={[type(f).__name__ for f in model.flows]}, "
+                f"is_partition={space.is_partition}, "
+                f"dtype={space.dtype}. Use 'xla', 'pallas' or 'auto'.")
         if base_ok:
             rates = model.pallas_rates()
             # empty/all-zero rates = no field transport: nothing for the
@@ -303,14 +330,14 @@ class ShardMapExecutor:
         deep = self.halo_depth > 1
         entry = self._cache.get(key)
         if entry is None:
-            prunner, out = self._probe_pallas(
+            kind, prunner, out = self._probe_pallas(
                 model, space, num_steps, values,
                 label="pallas-deep" if deep else "pallas",
                 fallback_name=("the XLA deep-halo path" if deep
                                else "the XLA pad-gather path"))
             if prunner is not None:
-                self._cache[key] = ("pallas", prunner)
-                self.last_impl = "pallas"
+                self._cache[key] = (kind, prunner)
+                self.last_impl = kind
                 return out
             with get_tracer().span("shardmap.build",
                                    impl="deep-halo" if deep else "xla",
@@ -331,16 +358,21 @@ class ShardMapExecutor:
         """Build + first-run the Pallas runner under one guard (BUILD-time
         validation errors — e.g. a ring deeper than the slab capacity —
         and compile/device faults degrade identically). Returns
-        ``(runner, first_output)`` on success; ``(None, None)`` when
-        ineligible or when ``"auto"`` should fall back; re-raises under
-        explicit ``step_impl="pallas"``. ``block_until_ready`` makes
-        async device faults surface HERE, not in the caller after a
-        broken runner got cached."""
+        ``(kind, runner, first_output)`` on success — ``kind`` is the
+        honest ``last_impl`` label ("pallas" or "composed") —
+        ``(None, None, None)`` when ineligible or when ``"auto"`` should
+        fall back; re-raises under explicit ``step_impl="pallas"`` /
+        ``"composed"``. ``block_until_ready`` makes async device faults
+        surface HERE, not in the caller after a broken runner got
+        cached."""
         from ..utils.tracing import get_tracer
 
         plan = self._pallas_plan(model, space)
         if plan is None:
-            return None, None
+            return None, None, None
+        kind = "composed" if plan[0] == "composed" else "pallas"
+        if kind == "composed":
+            label = f"composed-depth{self.halo_depth}"
         tracer = get_tracer()
         try:
             with tracer.span("shardmap.build", impl=label,
@@ -350,13 +382,13 @@ class ShardMapExecutor:
                 out = jax.block_until_ready(
                     prunner(values, jnp.int32(num_steps)))
         except Exception as e:
-            if self.step_impl == "pallas":
+            if self.step_impl in ("pallas", "composed"):
                 raise
             warnings.warn(
                 f"{label} step failed ({e!r}); falling back to "
                 f"{fallback_name}", RuntimeWarning)
-            return None, None
-        return prunner, out
+            return None, None, None
+        return kind, prunner, out
 
     def _shard_geometry(self, space: CellularSpace):
         """(names, nx, ny, local_h, local_w): this mesh's axis names,
@@ -387,7 +419,7 @@ class ShardMapExecutor:
                      if len(names) > 1 else jnp.int32(0))
             return run(values, off_x, off_y, n)
 
-        sharded = jax.shard_map(shard_fn, mesh=mesh, in_specs=(spec, P()),
+        sharded = shard_map(shard_fn, mesh=mesh, in_specs=(spec, P()),
                                 out_specs=spec)
         return jax.jit(sharded)
 
@@ -570,7 +602,7 @@ class ShardMapExecutor:
                 out = lax.switch(n - q * D, branches, out)
             return out
 
-        sharded = jax.shard_map(shard_fn, mesh=mesh, in_specs=(spec, P()),
+        sharded = shard_map(shard_fn, mesh=mesh, in_specs=(spec, P()),
                                 out_specs=spec)
         return jax.jit(sharded)
 
@@ -636,6 +668,23 @@ class ShardMapExecutor:
                             rate, offsets, interpret=interpret, nsteps=ns,
                             compute_dtype=cdt)
                     return new
+            elif kind == "composed":
+                from ..ops.composed_stencil import composed_halo_step
+
+                def chunk(c, ns):
+                    """ns flow steps as ONE composed (2·ns+1)² pass per
+                    depth-``ns`` exchange — interior tiles run the tap
+                    filter, near-global-edge tiles the exact iterated
+                    path (remainder chunks compose at their own ns)."""
+                    new = dict(c)
+                    for attr, rate in payload.items():
+                        if rate == 0.0:
+                            continue
+                        new[attr] = composed_halo_step(
+                            c[attr], ring_of(c[attr], ns), origin, gshape,
+                            rate, ns, offsets, interpret=interpret,
+                            compute_dtype=cdt)
+                    return new
             else:
                 def chunk(c, ns):
                     """One depth-``ns`` exchange of EVERY channel, then
@@ -659,7 +708,7 @@ class ShardMapExecutor:
 
         # check_vma=False: pallas_call's out_shape carries no
         # varying-mesh-axes metadata, which the checker would demand
-        sharded = jax.shard_map(shard_fn, mesh=mesh, in_specs=(spec, P()),
+        sharded = shard_map(shard_fn, mesh=mesh, in_specs=(spec, P()),
                                 out_specs=spec, check_vma=False)
         return jax.jit(sharded)
 
@@ -771,7 +820,7 @@ class ShardMapExecutor:
             return lax.fori_loop(
                 0, n, lambda i, c: local_step(c, counts, row0, col0), values)
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             shard_fn, mesh=mesh,
             in_specs=(spec, P()),
             out_specs=spec)

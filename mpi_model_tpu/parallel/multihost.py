@@ -381,6 +381,26 @@ else:
 """
 
 
+def probe_free_port() -> int:
+    """A coordinator port the OS just proved bindable: bind to port 0,
+    read the assignment, close. Replaces the old pid-derived arithmetic
+    (``30100 + pid % 350``), whose collisions across suite runs /
+    TIME_WAIT remnants the tests had to paper over with retries
+    (round-5 VERDICT weak #3). The close→reuse window is a benign race:
+    nothing else on the rig is grabbing ephemeral ports at this rate,
+    and a genuine collision still surfaces as the cluster-formation
+    error it always was instead of being masked by a hardcoded retry."""
+    import socket
+
+    s = socket.socket()
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return int(s.getsockname()[1])
+    finally:
+        s.close()
+
+
 def _launch_workers(codes: list, timeout: int, devices_per_proc: int = 4):
     """Spawn one subprocess per code string (virtual-CPU jax rig); return
     [(rc, stdout, stderr), ...] in order."""
@@ -423,8 +443,9 @@ def dryrun_supervised_kill(nprocs: int = 4, kill_rank: int = 2,
         raise ValueError("dryrun_supervised_kill needs >= 2 processes")
     if not 0 <= kill_rank < nprocs:
         raise ValueError(f"kill_rank {kill_rank} outside 0..{nprocs - 1}")
+    explicit_port = port is not None
     if port is None:
-        port = 30100 + os.getpid() % 350
+        port = probe_free_port()
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     ckpt_dir = tempfile.mkdtemp(prefix="mmtpu_supkill_")
@@ -449,8 +470,12 @@ def dryrun_supervised_kill(nprocs: int = 4, kill_rank: int = 2,
                 f"with the crash marker:\n{outs[kill_rank][1][-2000:]}\n"
                 f"{outs[kill_rank][2][-2000:]}")
 
-        # phase 2: fresh cluster (new port), same checkpoint directory
-        outs = _launch_workers(codes(2, port + 1), timeout,
+        # phase 2: fresh cluster on a freshly-probed port (phase 1's
+        # port may sit in TIME_WAIT — the victim died hard), same
+        # checkpoint directory. An explicit caller port keeps the old
+        # deterministic port+1 so rigs that pin firewalls still can.
+        port2 = (port + 1) if explicit_port else probe_free_port()
+        outs = _launch_workers(codes(2, port2), timeout,
                                devices_per_proc=2)
         for pid, (rc, out, err) in enumerate(outs):
             if rc != 0:
@@ -474,7 +499,7 @@ def dryrun_two_process(port: Optional[int] = None, timeout: int = 300) -> str:
     import tempfile
 
     if port is None:
-        port = 29500 + os.getpid() % 400  # avoid collisions between runs
+        port = probe_free_port()  # bind-probed; see probe_free_port
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     ckpt_dir = tempfile.mkdtemp(prefix="mmtpu_mh_")

@@ -53,6 +53,51 @@ def marginal_step_time(step: Callable, values: Values, s1: int = 50,
     return (times[s2] - times[s1]) / (s2 - s1)
 
 
+def _scan_runners(step: Callable, values: Values, lengths: tuple,
+                  donate: bool = True) -> dict:
+    """Build and WARM one donated-scan runner per scan length (compile
+    happens here, never inside a timed region): length → jitted
+    ``values -> (out, scalar)``; fetching the scalar forces completion
+    through the tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    runners = {}
+    for steps in lengths:
+        def run_fn(v, _steps=steps):
+            def body(c, _):
+                return step(c), None
+            out, _ = jax.lax.scan(body, v, None, length=_steps)
+            return out, jnp.sum(
+                jax.tree.leaves(out)[0].astype(jnp.float32))
+        run = jax.jit(run_fn, donate_argnums=0 if donate else ())
+        fresh = jax.tree.map(jnp.copy, values)
+        _, s = run(fresh)
+        _ = float(s)  # warmup / compile
+        runners[steps] = run
+    return runners
+
+
+def _marginal_sample(runners: dict, values: Values, s1: int,
+                     s2: int) -> float:
+    """One marginal per-step estimate from pre-warmed runners: both
+    scan lengths timed back-to-back so chip-state drift hits the two
+    arms of the estimate together."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    ts = {}
+    for steps in (s1, s2):
+        fresh = jax.tree.map(jnp.copy, values)
+        t0 = _time.perf_counter()
+        _, s = runners[steps](fresh)
+        _ = float(s)
+        ts[steps] = _time.perf_counter() - t0
+    return (ts[s2] - ts[s1]) / (s2 - s1)
+
+
 def marginal_step_trials(step: Callable, values: Values, s1: int = 10,
                          s2: int = 60, trials: int = 5,
                          donate: bool = True) -> list[float]:
@@ -65,36 +110,9 @@ def marginal_step_trials(step: Callable, values: Values, s1: int = 10,
     MEDIAN and report the min/max spread — BASELINE.md's noise
     discipline ("interleaved medians are not optional"), now applied to
     the driver headline too (round-4 VERDICT weak #1)."""
-    import time as _time
-
-    import jax
-    import jax.numpy as jnp
-
-    runners = {}
-    for steps in (s1, s2):
-        def run_fn(v, _steps=steps):
-            def body(c, _):
-                return step(c), None
-            out, _ = jax.lax.scan(body, v, None, length=_steps)
-            return out, jnp.sum(
-                jax.tree.leaves(out)[0].astype(jnp.float32))
-        run = jax.jit(run_fn, donate_argnums=0 if donate else ())
-        fresh = jax.tree.map(jnp.copy, values)
-        _, s = run(fresh)
-        _ = float(s)  # warmup / compile
-        runners[steps] = run
-
-    out: list[float] = []
-    for _ in range(trials):
-        ts = {}
-        for steps in (s1, s2):
-            fresh = jax.tree.map(jnp.copy, values)
-            t0 = _time.perf_counter()
-            _, s = runners[steps](fresh)
-            _ = float(s)
-            ts[steps] = _time.perf_counter() - t0
-        out.append((ts[s2] - ts[s1]) / (s2 - s1))
-    return out
+    runners = _scan_runners(step, values, (s1, s2), donate)
+    return [_marginal_sample(runners, values, s1, s2)
+            for _ in range(trials)]
 
 
 def marginal_runner_trials(make_output: Callable[[int], object],
@@ -120,20 +138,36 @@ def marginal_runner_trials(make_output: Callable[[int], object],
 
 
 def interleaved_ab(steps: dict, values: Values, *, s1: int = 5,
-                   s2: int = 25, reps: int = 4) -> dict:
+                   s2: int = 25, reps: int = 4,
+                   spread: bool = False) -> dict:
     """Interleaved A/B medians: one marginal sample per arm per round,
     arms alternating so chip-state drift on the shared tunnel chip hits
     every arm of a round together (BASELINE.md's noise discipline —
     speedup claims are only made when they survive interleaving).
-    ``steps`` maps arm name → step function; returns arm name → median
-    marginal seconds per step call."""
-    import statistics
 
+    EVERY arm's two scan-length runners are built and warmed up front
+    (one compile per arm per length, the same once-only protocol as
+    ``marginal_step_trials``) — the rounds are then pure timing, so
+    ``reps`` can be raised to settle a claim without re-paying ``reps``
+    jit compilations per arm (the round-5 harness re-jitted both scan
+    lengths every round, which both wasted minutes and let compile-side
+    state leak into the later rounds' timings).
+
+    ``steps`` maps arm name → step function; returns arm name → median
+    marginal seconds per step call, or — with ``spread=True`` — arm
+    name → ``{value, spread_lo, spread_hi}`` so callers can test
+    whether an A/B gap clears the cross-round spread."""
+    runners = {name: _scan_runners(step, values, (s1, s2))
+               for name, step in steps.items()}
     times: dict = {name: [] for name in steps}
     for _ in range(reps):
-        for name, step in steps.items():
-            times[name].append(marginal_step_time(step, values,
-                                                  s1=s1, s2=s2, reps=1))
+        for name in steps:
+            times[name].append(
+                _marginal_sample(runners[name], values, s1, s2))
+    if spread:
+        return {name: median_spread(ts) for name, ts in times.items()}
+    import statistics
+
     return {name: statistics.median(ts) for name, ts in times.items()}
 
 

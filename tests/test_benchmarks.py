@@ -23,7 +23,7 @@ def test_ladder_config1_quick():
     assert row["config"] == 1
     assert row["oracle_cups"] > 0
     assert row["framework_impl"] in ("point", "xla", "pallas")
-    assert row["native_threads_cups"] is None  # skipped in quick mode
+    assert row["native_correctness_cups"] is None  # skipped in quick mode
 
 
 def test_ladder_config2_quick():
@@ -31,6 +31,19 @@ def test_ladder_config2_quick():
     assert row["config"] == 2
     assert "halo_share" in row
     assert row["strategy"].startswith("1-D row stripes")
+
+
+def test_bench_tolerance_lookup_clear_error():
+    """A dtype outside the gates' calibrated tiers must fail with a
+    clear message, not a bare KeyError mid-gate (ISSUE 1 satellite)."""
+    import pytest
+
+    import bench
+
+    assert bench._tol_for(4, "float32") == bench._tols(4)["float32"]
+    assert bench._tol_for(1, "bfloat16") == 0.04
+    with pytest.raises(ValueError, match="no oracle tolerance"):
+        bench._tol_for(4, "float64")
 
 
 def test_roofline_fields():
@@ -123,3 +136,12 @@ def test_timing_trial_helpers():
 
     med = interleaved_ab({"a": step, "b": step}, v0, s1=1, s2=2, reps=2)
     assert set(med) == {"a", "b"}
+
+    # spread mode (the config-4 settle protocol): per-arm median+spread
+    # from the warmed-once harness
+    ab = interleaved_ab({"a": step, "b": step}, v0, s1=1, s2=2, reps=3,
+                        spread=True)
+    assert set(ab) == {"a", "b"}
+    for arm in ab.values():
+        assert set(arm) == {"value", "spread_lo", "spread_hi"}
+        assert arm["spread_lo"] <= arm["value"] <= arm["spread_hi"]

@@ -17,6 +17,7 @@ import subprocess
 import sys
 
 import jax
+import pytest
 
 from mpi_model_tpu import cli
 
@@ -26,6 +27,7 @@ JUDGE_CMD = ["run", "--flow=diffusion", "--dimx=64", "--dimy=64",
              "--json"]
 
 
+@pytest.mark.slow  # subprocess-spawning: reproduces the raw-environment crash
 def test_pallas_on_cpu_mesh_without_conftest_pins():
     """The round-3 judge-crash command, in a subprocess WITHOUT the test
     rig's jax_default_device pin (and without JAX_PLATFORMS=cpu, so a

@@ -1,0 +1,321 @@
+"""Golden tests for the composed k-step filter (ops.composed_stencil).
+
+ISSUE 1 tentpole: one (2k+1)² tap pass must equal k iterated radius-1
+flow steps — interior cells via the composed filter (VPU binomial and
+MXU banded lowerings), the near-boundary band via the exact iterated
+path, conservation preserved — serially, through Model(impl='composed'),
+and through ShardMapExecutor(step_impl='composed') with the depth-k
+ghost exchange. All interpret-mode on CPU (exact same code path the
+silicon bench gates run with interpret=False).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mpi_model_tpu import CellularSpace, Coupled, Diffusion, Model
+from mpi_model_tpu.core.cell import MOORE_OFFSETS, VON_NEUMANN_OFFSETS
+from mpi_model_tpu.models.model import SerialExecutor
+from mpi_model_tpu.ops.composed_stencil import (
+    ComposedDiffusionStep,
+    choose_k,
+    composed_dense_step,
+    composed_taps,
+    max_k,
+    taps_fingerprint,
+)
+from mpi_model_tpu.ops.pallas_stencil import pallas_dense_step
+from mpi_model_tpu.oracle import dense_flow_step_np
+
+RNG = np.random.default_rng(7)
+RATE = 0.1
+
+
+def _grid(h, w, dtype=np.float32):
+    return RNG.uniform(0.5, 2.0, (h, w)).astype(dtype)
+
+
+def _oracle(v, k, rate=RATE, offsets=MOORE_OFFSETS):
+    want = v.astype(np.float64)
+    for _ in range(k):
+        want = dense_flow_step_np(want, rate, offsets=offsets)
+    return want
+
+
+# -- tap tables --------------------------------------------------------------
+
+def test_taps_compose_and_conserve():
+    for k in (1, 2, 4, 8):
+        t = composed_taps(RATE, MOORE_OFFSETS, k)
+        assert t.shape == (2 * k + 1, 2 * k + 1)
+        # every step conserves interior mass, so the composition does
+        assert abs(t.sum() - 1.0) < 1e-12
+
+
+def test_taps_k1_is_the_one_step_table():
+    t = composed_taps(0.2, VON_NEUMANN_OFFSETS, 1)
+    want = np.zeros((3, 3))
+    want[1, 1] = 0.8
+    for dx, dy in VON_NEUMANN_OFFSETS:
+        want[1 + dx, 1 + dy] = 0.2 / 4
+    np.testing.assert_allclose(t, want, atol=1e-15)
+
+
+def test_taps_cached_by_fingerprint():
+    a = composed_taps(RATE, MOORE_OFFSETS, 4)
+    b = composed_taps(RATE, MOORE_OFFSETS, 4)
+    assert a is b  # same fingerprint -> same cached table
+    assert not a.flags.writeable
+    assert (taps_fingerprint(RATE, MOORE_OFFSETS, 4)
+            != taps_fingerprint(RATE, MOORE_OFFSETS, 5))
+
+
+# -- dense composed pass vs k iterated oracle steps --------------------------
+
+@pytest.mark.parametrize("offsets", [MOORE_OFFSETS, VON_NEUMANN_OFFSETS])
+@pytest.mark.parametrize("k", [1, 2, 4])
+@pytest.mark.parametrize("variant", ["vpu", "mxu"])
+def test_matches_iterated_oracle(offsets, k, variant):
+    """Full-grid agreement (interior tap pass + near-band iterated path)
+    with k iterated radius-1 oracle steps; (128, 512) at block (32, 128)
+    puts genuine interior tiles on the composed path."""
+    v = _grid(128, 512)
+    want = _oracle(v, k, offsets=offsets)
+    got = np.asarray(composed_dense_step(
+        jnp.asarray(v), RATE, k, offsets=offsets, block=(32, 128),
+        interpret=True, variant=variant), np.float64)
+    np.testing.assert_allclose(got, want, rtol=0, atol=2e-6 * k)
+
+
+def test_k8_seventeen_taps_both_variants():
+    v = _grid(128, 512)
+    want = _oracle(v, 8)
+    for variant in ("vpu", "mxu"):
+        got = np.asarray(composed_dense_step(
+            jnp.asarray(v), RATE, 8, block=(32, 128), interpret=True,
+            variant=variant), np.float64)
+        np.testing.assert_allclose(got, want, rtol=0, atol=2e-5)
+
+
+def test_interior_hook_actually_ran():
+    """The composed pass must DIFFER bitwise from the iterated kernel on
+    interior cells (different FP grouping) while both match the oracle —
+    otherwise the hook silently fell back to the iterated path and the
+    suite would be testing nothing new."""
+    v = _grid(128, 512)
+    it = np.asarray(pallas_dense_step(jnp.asarray(v), RATE, nsteps=4,
+                                      block=(32, 128), interpret=True))
+    comp = np.asarray(composed_dense_step(jnp.asarray(v), RATE, 4,
+                                          block=(32, 128), interpret=True,
+                                          variant="vpu"))
+    interior = (slice(40, 88), slice(140, 360))  # inside interior tiles
+    assert not np.array_equal(comp[interior], it[interior])
+
+
+def test_near_band_is_the_exact_iterated_path():
+    """Cells within k of the true edge run the SAME exact masked code as
+    the iterated kernel — bitwise, not just within tolerance."""
+    k = 4
+    v = _grid(128, 512)
+    it = np.asarray(pallas_dense_step(jnp.asarray(v), RATE, nsteps=k,
+                                      block=(32, 128), interpret=True))
+    comp = np.asarray(composed_dense_step(jnp.asarray(v), RATE, k,
+                                          block=(32, 128), interpret=True))
+    for band in (np.s_[:k, :], np.s_[-k:, :], np.s_[:, :k], np.s_[:, -k:]):
+        np.testing.assert_array_equal(comp[band], it[band])
+
+
+def test_variants_agree():
+    v = _grid(128, 512)
+    a = np.asarray(composed_dense_step(jnp.asarray(v), RATE, 4,
+                                       block=(32, 128), interpret=True,
+                                       variant="vpu"), np.float64)
+    b = np.asarray(composed_dense_step(jnp.asarray(v), RATE, 4,
+                                       block=(32, 128), interpret=True,
+                                       variant="mxu"), np.float64)
+    np.testing.assert_allclose(a, b, rtol=0, atol=1e-5)
+
+
+def test_mass_conservation_many_passes():
+    v = jnp.asarray(_grid(96, 256))
+    total0 = float(jnp.sum(jnp.asarray(v, jnp.float64)))
+    stepper = ComposedDiffusionStep((96, 256), 0.15, 4, block=(32, 128),
+                                    interpret=True)
+    for _ in range(5):
+        v = stepper(v)
+    total = float(jnp.sum(jnp.asarray(v, jnp.float64)))
+    assert abs(total - total0) < total0 * 20 * 1e-6
+
+
+def test_bf16_storage_matches_oracle_loosely():
+    v = _grid(64, 256)
+    want = _oracle(v, 4)
+    got = np.asarray(composed_dense_step(
+        jnp.asarray(v, jnp.bfloat16), RATE, 4, block=(32, 128),
+        interpret=True).astype(jnp.float32), np.float64)
+    np.testing.assert_allclose(got, want, rtol=0, atol=0.04)
+
+
+# -- k selection and misuse --------------------------------------------------
+
+def test_max_k_and_choose_k():
+    assert max_k((512, 512), jnp.float32) == 8     # f32 sublane
+    assert max_k((512, 512), jnp.bfloat16) == 16   # bf16 sublane
+    assert choose_k(4, (512, 512), jnp.float32) == 4
+    assert choose_k(12, (512, 512), jnp.float32) == 6   # 12 > cap 8
+    assert choose_k(12, (512, 512), jnp.bfloat16) == 12
+    assert choose_k(7, (512, 512), jnp.float32) == 7
+    assert choose_k(1, (512, 512), jnp.float32) == 1
+
+
+def test_k_beyond_window_depth_raises():
+    with pytest.raises(ValueError, match="ghost depth|exceeds"):
+        composed_dense_step(jnp.ones((64, 256), jnp.float32), RATE, 9,
+                            block=(32, 128), interpret=True)
+    with pytest.raises(ValueError, match="exceeds the window ghost depth"):
+        ComposedDiffusionStep((64, 256), RATE, 9, block=(32, 128))
+
+
+def test_mxu_needs_lane_aligned_block():
+    with pytest.raises(ValueError, match="128"):
+        composed_dense_step(jnp.ones((64, 64), jnp.float32), RATE, 4,
+                            block=(32, 64), interpret=True, variant="mxu")
+
+
+def test_unknown_variant_rejected():
+    with pytest.raises(ValueError, match="variant"):
+        composed_dense_step(jnp.ones((64, 128), jnp.float32), RATE, 2,
+                            interpret=True, variant="tensor-cores")
+
+
+# -- Model / executor integration --------------------------------------------
+
+def test_model_impl_composed_matches_xla():
+    g = 160
+    v0 = _grid(g, g)
+    space = CellularSpace.create(g, g, 1.0, dtype="float32")
+    space = space.with_values({"value": jnp.asarray(v0)})
+    model = Model(Diffusion(RATE), 1.0, 1.0)
+    step = model.make_step(space, impl="composed", substeps=8)
+    assert step.impl == "composed"
+    got = np.asarray(step(dict(space.values))["value"], np.float64)
+    np.testing.assert_allclose(got, _oracle(v0, 8), rtol=0, atol=2e-5)
+
+
+def test_serial_executor_composed_reports_and_conserves():
+    space = CellularSpace.create(128, 128, 1.0, dtype="float32")
+    model = Model(Diffusion(RATE), 1.0, 1.0)
+    ex = SerialExecutor(step_impl="composed", substeps=4)
+    out, rep = model.execute(space, ex, steps=10)
+    assert ex.last_impl == "composed"
+    assert rep.conservation_error() <= model.conservation_threshold(space)
+
+
+def test_impl_composed_requires_uniform_diffusion():
+    space = CellularSpace.create(64, 64, {"a": 1.0, "b": 0.5},
+                                 dtype="float32")
+    model = Model([Coupled(flow_rate=0.05, attr="a", modulator="b")],
+                  1.0, 1.0)
+    with pytest.raises(ValueError, match="composed"):
+        model.make_step(space, impl="composed", substeps=4)
+
+
+def test_impl_composed_rejects_f64():
+    space = CellularSpace.create(64, 64, 1.0, dtype="float64")
+    model = Model(Diffusion(RATE), 1.0, 1.0)
+    with pytest.raises(ValueError, match="composed"):
+        model.make_step(space, impl="composed", substeps=4)
+
+
+def test_auto_k_divides_substeps():
+    """substeps=12 on f32 (cap 8) must pick k=6: two composed passes per
+    compiled call, no remainder step."""
+    g = 128
+    v0 = _grid(g, g)
+    space = CellularSpace.create(g, g, 1.0, dtype="float32")
+    space = space.with_values({"value": jnp.asarray(v0)})
+    model = Model(Diffusion(RATE), 1.0, 1.0)
+    step = model.make_step(space, impl="composed", substeps=12)
+    got = np.asarray(step(dict(space.values))["value"], np.float64)
+    np.testing.assert_allclose(got, _oracle(v0, 12), rtol=0, atol=3e-5)
+
+
+# -- sharded: ShardMapExecutor(step_impl="composed") -------------------------
+
+@pytest.fixture(scope="module")
+def mesh1d(eight_devices):
+    from mpi_model_tpu.parallel import make_mesh
+
+    return make_mesh(4, devices=eight_devices[:4])
+
+
+@pytest.fixture(scope="module")
+def mesh2d(eight_devices):
+    from mpi_model_tpu.parallel import make_mesh_2d
+
+    return make_mesh_2d(2, 4, devices=eight_devices)
+
+
+@pytest.mark.parametrize("steps,depth", [(8, 4), (10, 4), (6, 2)])
+def test_shardmap_composed_matches_oracle_1d(mesh1d, steps, depth):
+    """Depth-d exchange feeding one composed pass per chunk — including
+    the remainder chunk (10 % 4 = 2: a k=2 composed pass)."""
+    from mpi_model_tpu.parallel import ShardMapExecutor
+
+    g = 128
+    v0 = _grid(g, g)
+    space = CellularSpace.create(g, g, 1.0, dtype="float32")
+    space = space.with_values({"value": jnp.asarray(v0)})
+    model = Model(Diffusion(RATE), 1.0, 1.0)
+    ex = ShardMapExecutor(mesh1d, step_impl="composed", halo_depth=depth)
+    out = ex.run_model(model, space, steps)
+    assert ex.last_impl == "composed"
+    got = np.asarray(out["value"], np.float64)
+    np.testing.assert_allclose(got, _oracle(v0, steps), rtol=0,
+                               atol=2e-6 * steps)
+
+
+def test_shardmap_composed_matches_oracle_2d(mesh2d):
+    from mpi_model_tpu.parallel import ShardMapExecutor
+
+    g = 128
+    v0 = _grid(g, g)
+    space = CellularSpace.create(g, g, 1.0, dtype="float32")
+    space = space.with_values({"value": jnp.asarray(v0)})
+    model = Model(Diffusion(RATE), 1.0, 1.0)
+    ex = ShardMapExecutor(mesh2d, step_impl="composed", halo_depth=4)
+    out = ex.run_model(model, space, 8)
+    assert ex.last_impl == "composed"
+    got = np.asarray(out["value"], np.float64)
+    np.testing.assert_allclose(got, _oracle(v0, 8), rtol=0, atol=2e-5)
+
+
+def test_shardmap_composed_rejects_coupled(mesh1d):
+    from mpi_model_tpu.parallel import ShardMapExecutor
+
+    space = CellularSpace.create(64, 64, {"a": 1.0, "b": 0.5},
+                                 dtype="float32")
+    model = Model([Coupled(flow_rate=0.05, attr="a", modulator="b")],
+                  1.0, 1.0)
+    ex = ShardMapExecutor(mesh1d, step_impl="composed", halo_depth=2)
+    with pytest.raises(ValueError, match="composed"):
+        ex.run_model(model, space, 4)
+
+
+def test_model_rectangular_composed_passthrough(eight_devices):
+    """ModelRectangular(step_impl='composed') reaches the composed halo
+    kernel through its block-mesh executor."""
+    from mpi_model_tpu.models.model_rectangular import ModelRectangular
+
+    g = 64
+    v0 = _grid(g, g)
+    space = CellularSpace.create(g, g, 1.0, dtype="float32")
+    space = space.with_values({"value": jnp.asarray(v0)})
+    model = ModelRectangular(Diffusion(RATE), 4.0, 1.0, lines=2, columns=2,
+                             step_impl="composed", halo_depth=2)
+    ex = model.default_executor(devices=eight_devices[:4])
+    out, rep = model.execute(space, ex, steps=4)
+    assert ex.last_impl == "composed"
+    got = np.asarray(out.values["value"], np.float64)
+    np.testing.assert_allclose(got, _oracle(v0, 4), rtol=0, atol=1e-5)

@@ -10,6 +10,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow  # subprocess-spawning: full interpreter + jax init per script
 @pytest.mark.parametrize("script", ["reference_run.py", "scaling.py"])
 def test_example_runs(script):
     env = dict(os.environ)

@@ -10,6 +10,8 @@ jax import. These tests exercise both paths.
 
 import os
 import subprocess
+
+import pytest
 import sys
 
 import jax
@@ -26,11 +28,14 @@ def test_entry_compiles():
     assert set(out) == {"a", "b"}
 
 
+@pytest.mark.slow  # heavyweight: the full multichip dryrun (~35s);
+# the driver also runs it directly via `python __graft_entry__.py`
 def test_dryrun_in_process():
     # conftest provisions 8 virtual CPU devices, so this runs in-process.
     graft.dryrun_multichip(8)
 
 
+@pytest.mark.slow  # subprocess-spawning: fresh interpreter, no conftest flags
 def test_dryrun_bootstraps_without_flags():
     """From a parent with NO xla_force_host_platform_device_count (the
     driver environment), dryrun_multichip must still produce a green

@@ -98,6 +98,7 @@ def test_native_executor_threaded_multiattr():
     assert rep.comm_size == 8
 
 
+@pytest.mark.slow  # subprocess-spawning: native driver executable
 def test_driver_executable():
     exe = os.path.join(native._NATIVE_DIR, "build", "mmtpu_main")
     if not os.path.exists(exe):
@@ -128,6 +129,7 @@ def test_native_executor_surfaces_backend_report():
     assert rep2.rank_id == 0  # single-process: jax.process_index()
 
 
+@pytest.mark.slow  # subprocess-spawning: native driver executable
 def test_driver_tpu_backend():
     """--backend=tpu embeds CPython and drives the JAX path; the printed
     status is COMPUTED from the report (round-2 VERDICT weak #6), and the
@@ -228,6 +230,7 @@ def test_native_typed_wire_rejects_mismatch():
     assert selftest_typed_wire() is True
 
 
+@pytest.mark.slow  # subprocess-spawning: native driver executable
 def test_driver_dtype_flag():
     """The native driver's --dtype flag: the reference's compile-time T
     template parameter as a runtime switch, both backends conserving."""

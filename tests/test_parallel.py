@@ -305,8 +305,9 @@ def test_global_sum_psum(mesh1d):
     def f(xl):
         return global_sum(xl, "x")
 
-    got = jax.jit(jax.shard_map(f, mesh=mesh1d, in_specs=P("x", None),
-                                out_specs=P()))(x)
+    from mpi_model_tpu.compat import shard_map
+    got = jax.jit(shard_map(f, mesh=mesh1d, in_specs=P("x", None),
+                            out_specs=P()))(x)
     assert float(got) == pytest.approx(float(x.sum()))
 
 
@@ -586,6 +587,7 @@ def test_shardmap_pallas_field_kernel_matches_serial(meshname, request):
     assert rep.conservation_error() < 1e-2  # f32 rounding only
 
 
+@pytest.mark.slow  # heavyweight: ~60s of interpret-mode field kernels
 @pytest.mark.parametrize("depth", [2, 3])
 def test_shardmap_pallas_field_kernel_deep_halo(mesh2d, depth):
     """Field kernel + deep halos: a depth-d per-channel ring feeds d

@@ -160,6 +160,7 @@ def test_trace_span_uses_current_default():
     assert [s.name for s in tr.spans] == ["x"]
 
 
+@pytest.mark.slow  # heavyweight: jax.profiler device-trace round-trip (~20s)
 def test_device_trace_writes_profile(tmp_path):
     tr = Tracer()
     logdir = str(tmp_path / "prof")
