@@ -108,15 +108,13 @@ def _tol_for(substeps: int, dtype) -> float:
 
 
 def _cups_spread(samples: list, cells: float) -> dict:
-    """cups spread implied by the POSITIVE marginal samples — a
-    transient can make an individual marginal estimate non-positive
-    even when the median is sound, and such samples carry no spread
-    information (a negative per-step time inverts into a negative cups
-    bound). Null fields when none survive (the halo row's med<=0
-    discipline)."""
-    pos = [s for s in samples if s > 0]
-    return {"spread_lo": cells / max(pos) if pos else None,
-            "spread_hi": cells / min(pos) if pos else None}
+    """cups spread implied by the POSITIVE marginal samples
+    (``utils.metrics.positive_spread`` — the shared noise-filtering
+    policy), in this row's ``spread_lo``/``spread_hi`` field names."""
+    from mpi_model_tpu.utils import positive_spread
+
+    sp = positive_spread(samples, cells)
+    return {"spread_lo": sp["lo"], "spread_hi": sp["hi"]}
 
 
 def _max_err(a, b) -> float:
@@ -375,6 +373,123 @@ def bench_composed(space, model, dense_step, substeps: int,
     }
 
 
+def bench_ensemble(grid: int = 4096, B: int = 8, steps: int = 8,
+                   dtype_name: str = "bfloat16", impl: str = "xla",
+                   substeps: int = 1, trials: int = 5,
+                   verbose: bool = False) -> dict:
+    """Ensemble-serving throughput (ISSUE 2): scenarios/s of the batched
+    engine — one device program stepping B scenarios through the FULL
+    serving stack (service → bucketed scheduler → batched runner) — vs
+    the sequential one-at-a-time SerialExecutor baseline, both reported
+    as the median of ``trials`` marginal estimates + spread (the
+    BASELINE noise discipline). The row carries the scheduler's
+    batch-occupancy and compile-cache-hit counters. Scenarios differ in
+    initial state AND (except under impl='pipeline', whose kernel rate
+    is compile-time static) in rate — the vmapped engine's real
+    workload. Before any timing, one batched dispatch is gated against
+    per-scenario serial runs at the batch's edge lanes."""
+    import statistics
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_model_tpu import CellularSpace, Diffusion, Model
+    from mpi_model_tpu.ensemble import (EnsembleExecutor, EnsembleService,
+                                        buckets_for)
+    from mpi_model_tpu.models.model import SerialExecutor
+    from mpi_model_tpu.utils import marginal_runner_trials
+
+    enable_compile_cache()
+    dtype = jnp.dtype(dtype_name)
+    rng = np.random.default_rng(7)
+    base = rng.uniform(0.5, 2.0, (grid, grid)).astype(np.float32)
+    spaces, models = [], []
+    for i in range(B):
+        v = jnp.asarray(np.roll(base, 7 * i, axis=0), dtype)
+        spaces.append(CellularSpace.create(grid, grid, 1.0, dtype=dtype)
+                      .with_values({"value": v}))
+        rate = (RATE if impl == "pipeline"
+                else RATE * (1.0 + 0.05 * i / max(B - 1, 1)))
+        models.append(Model(Diffusion(rate), 1.0, 1.0))
+    template = models[0]
+
+    svc = EnsembleService(template, steps=steps, impl=impl,
+                          substeps=substeps, buckets=buckets_for(B))
+    # correctness gate on the batch's edge lanes (first/last): the
+    # batched engine vs a per-scenario serial run, before any timing.
+    # The gate runs on its OWN executor — sharing the timed service's
+    # would pre-build its batch-B runner, making the published
+    # compile-cache-hit rate 1.0 by construction
+    outs = template.execute_many(
+        spaces, models=models,
+        executor=EnsembleExecutor(impl=impl, substeps=substeps),
+        steps=steps)
+    ser = SerialExecutor(step_impl="xla")
+    tol = _tol_for(steps, dtype_name)
+    for i in {0, B - 1}:
+        want, _ = models[i].execute(spaces[i], ser, steps=steps,
+                                    check_conservation=False)
+        err = _max_err(outs[i][0].values["value"], want.values["value"])
+        if err > tol:
+            raise AssertionError(
+                f"ensemble gate failed (scenario {i}, {impl}): "
+                f"max|err|={err:.3e} > {tol:.1e} vs the serial run")
+    if verbose:
+        print(f"  ensemble gate OK ({impl}, B={B}): lanes 0/{B - 1} "
+              f"within {tol:.1e}", file=sys.stderr)
+
+    def run_batched(n: int) -> None:
+        for _ in range(n):
+            tickets = [svc.submit(spaces[i], model=models[i])
+                       for i in range(B)]
+            svc.flush()
+            for t in tickets:
+                svc.result(t)
+
+    run_batched(1)  # warm the service path (builds the serving runner)
+    bs = marginal_runner_trials(run_batched, s1=2, s2=6, trials=trials)
+    bmed = statistics.median(bs)
+
+    def run_seq(n: int) -> None:
+        for _ in range(n):
+            for i in range(B):
+                models[i].execute(spaces[i], ser, steps=steps)
+
+    run_seq(1)
+    ss = marginal_runner_trials(run_seq, s1=1, s2=3, trials=trials)
+    smed = statistics.median(ss)
+
+    st = svc.stats()
+    from mpi_model_tpu.utils import positive_spread
+
+    bsp = positive_spread(bs, B)
+    ssp = positive_spread(ss, B)
+    row = {
+        "metric": f"ensemble scenarios/s ({B}x {grid}^2 {dtype_name}, "
+                  f"{steps} steps/scenario, {impl}, median of {trials})",
+        "ensemble_B": B, "grid": grid, "steps": steps, "impl": impl,
+        "substeps": substeps, "trials": trials,
+        "scenarios_per_s": B / bmed if bmed > 0 else None,
+        "scenarios_per_s_spread": [bsp["lo"], bsp["hi"]],
+        "seq_scenarios_per_s": B / smed if smed > 0 else None,
+        "seq_scenarios_per_s_spread": [ssp["lo"], ssp["hi"]],
+        "ensemble_speedup": (smed / bmed
+                             if bmed > 0 and smed > 0 else None),
+        # cell-updates/s alongside scenarios/s (the ladder's common unit)
+        "cups": (grid * grid * steps * B / bmed if bmed > 0 else None),
+        "batch_occupancy": st["batch_occupancy"],
+        "compile_cache_hits": st["compile_cache_hits"],
+        "compile_cache_hit_rate": st["compile_cache_hit_rate"],
+        "dispatches": st["dispatches"],
+    }
+    if verbose:
+        print(f"  ensemble {impl} B={B}: "
+              f"{row['scenarios_per_s'] or float('nan'):.2f} scen/s vs "
+              f"{row['seq_scenarios_per_s'] or float('nan'):.2f} "
+              "sequential", file=sys.stderr)
+    return row
+
+
 def bench_halo_mode(space, model, dense_step, substeps: int,
                     trials: int = 3, verbose: bool = False) -> dict:
     """Time the full sharded architecture on a 1-device TPU mesh: the
@@ -529,6 +644,15 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
     roof = stencil_roofline(
         grid, jnp.dtype(dtype).itemsize, t / substeps,
         substeps=substeps if impl_used == "pallas" else 1)
+    # the ensemble-serving row (ISSUE 2): B scenarios per dispatch at a
+    # smaller grid (B x the bench grid would not fit HBM); an ensemble
+    # failure is reported honestly without sinking the headline
+    try:
+        ensemble = bench_ensemble(grid=4096, B=8, steps=8,
+                                  dtype_name=dtype_name, trials=trials,
+                                  verbose=verbose)
+    except Exception as e:  # noqa: BLE001 — per-row honesty
+        ensemble = {"error": str(e)[:300]}
     return {
         "metric": f"cell-updates/sec/chip (dense Moore-8 flow step, "
                   f"{grid}x{grid} {dtype_name}, {impl_used} x{substeps}, "
@@ -550,6 +674,7 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
         **halo,
         **composed,
         **roof,
+        "ensemble": ensemble,
     }
 
 

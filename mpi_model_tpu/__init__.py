@@ -28,6 +28,13 @@ from .resilience import (
     check_health,
     supervised_run,
 )
+from .ensemble import (
+    EnsembleConservationError,
+    EnsembleExecutor,
+    EnsembleScheduler,
+    EnsembleService,
+    EnsembleSpace,
+)
 
 __version__ = "0.1.0"
 
@@ -51,5 +58,10 @@ __all__ = [
     "SimulationFailure",
     "check_health",
     "supervised_run",
+    "EnsembleConservationError",
+    "EnsembleExecutor",
+    "EnsembleScheduler",
+    "EnsembleService",
+    "EnsembleSpace",
     "__version__",
 ]

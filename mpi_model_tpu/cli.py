@@ -160,6 +160,75 @@ def _build_executor(args, model):
                             compute_dtype=_compute_dtype(args))
 
 
+def _run_ensemble(args, space, model) -> int:
+    """``--ensemble B``: B copies of the configured scenario through the
+    full serving stack (EnsembleService → bucketed scheduler → batched
+    engine), so the CLI reports what a deployment would see: per-scenario
+    conservation, scenarios/s, batch occupancy and compile-cache hits.
+    Conservation is judged here (status + exit code), not raised
+    mid-flight — the CLI's contract everywhere else."""
+    import time as _time
+
+    from .ensemble import EnsembleService, buckets_for
+
+    B = args.ensemble
+    steps = args.steps if args.steps is not None else model.num_steps
+    svc = EnsembleService(
+        model, steps=steps, impl=args.ensemble_impl,
+        substeps=args.substeps, buckets=buckets_for(B),
+        compute_dtype=_compute_dtype(args), check_conservation=False)
+    t0 = _time.perf_counter()
+    try:
+        tickets = [svc.submit(space) for _ in range(B)]
+        svc.flush()
+        outs = [svc.result(t) for t in tickets]
+    except (TypeError, ValueError) as e:
+        # engine ineligibility (e.g. --ensemble-impl=pipeline on a
+        # non-Diffusion flow or a non-strip grid) is CLI misuse, not a
+        # crash: the flag-surface discipline, not a raw traceback
+        raise SystemExit(f"ensemble run failed: {e}")
+    wall = _time.perf_counter() - t0
+    st = svc.stats()
+
+    thresh = model.conservation_threshold(space)
+    errs = [rep.conservation_error() for _, rep in outs]
+    err = max(errs)
+    conserved = bool(err <= thresh)
+    initial = {k: sum(rep.initial_total[k] for _, rep in outs)
+               for k in outs[0][1].initial_total}
+    final = {k: sum(rep.final_total[k] for _, rep in outs)
+             for k in outs[0][1].final_total}
+    result = {
+        "backend": "ensemble",
+        "ranks": 1,
+        "ensemble": B,
+        "steps": steps,
+        "initial": initial,
+        "final": final,
+        "conservation_error": err,
+        "conserved": conserved,
+        "wall_s": wall,
+        "impl": args.ensemble_impl,
+        "substeps": args.substeps,
+        "scenarios_per_s": st["scenarios_per_s"],
+        "batch_occupancy": st["batch_occupancy"],
+        "compile_cache_hits": st["compile_cache_hits"],
+        "dispatches": st["dispatches"],
+    }
+    if args.json:
+        print(json.dumps(result, allow_nan=False))
+    else:
+        status = "CONSERVED" if conserved else "VIOLATED"
+        sps = st["scenarios_per_s"]
+        rate = f"{sps:.1f} scenarios/s, " if sps else ""
+        print(f"backend=ensemble impl={args.ensemble_impl} B={B} "
+              f"steps={steps} max|delta|={err:.3e} {status} "
+              f"({wall:.2f}s, {rate}"
+              f"occupancy={st['batch_occupancy']:.2f}, "
+              f"{st['dispatches']} dispatches)")
+    return 0 if conserved else 1
+
+
 def cmd_run(args) -> int:
     import time as _time
 
@@ -206,6 +275,29 @@ def cmd_run(args) -> int:
                          "--mesh/--rectangular")
     if args.channels != 2 and args.flow != "coupled":
         raise SystemExit("--channels applies to --flow=coupled")
+    if args.ensemble is not None:
+        if args.ensemble < 1:
+            raise SystemExit(f"--ensemble={args.ensemble} needs B >= 1")
+        if sharded:
+            raise SystemExit(
+                "--ensemble batches B whole scenarios into one device "
+                "program (the batch axis replaces the mesh axes); drop "
+                "--mesh/--rectangular")
+        if args.checkpoint_dir is not None:
+            raise SystemExit(
+                "--ensemble does not compose with --checkpoint-dir "
+                "(supervised/checkpointed runs are single-scenario)")
+        if args.output is not None:
+            raise SystemExit(
+                "--output writes one scenario's dump; it does not "
+                "compose with --ensemble")
+        if args.impl != "auto":
+            raise SystemExit(
+                "--impl selects the single-run kernel; ensemble runs "
+                "use --ensemble-impl=xla|pipeline")
+    elif args.ensemble_impl != "xla":
+        raise SystemExit("--ensemble-impl applies to ensemble runs; "
+                         "add --ensemble=B")
     if args.owner_of is not None and args.rectangular is None:
         raise SystemExit(
             "--owner-of reports the 2-D block owner map; add "
@@ -218,6 +310,8 @@ def cmd_run(args) -> int:
                       if args.rectangular is not None else None)
 
     space, model = _build_model(args)
+    if args.ensemble is not None:
+        return _run_ensemble(args, space, model)
     executor = _build_executor(args, model)
     steps = args.steps if args.steps is not None else model.num_steps
     initial = {k: float(space.total(k)) for k in space.values}
@@ -406,6 +500,19 @@ def main(argv: Optional[list[str]] = None) -> int:
                      "throughput; the near-ring exact path stays f32)")
     run.add_argument("--substeps", type=int, default=1,
                      help="fused steps per compiled call (serial executor)")
+    run.add_argument("--ensemble", type=int, default=None, metavar="B",
+                     help="step B independent copies of the scenario as "
+                     "ONE batched device program through the ensemble "
+                     "serving stack (bucketed scheduler + per-scenario "
+                     "conservation); reports scenarios/s, batch "
+                     "occupancy and compile-cache hits")
+    run.add_argument("--ensemble-impl", default="xla",
+                     choices=["xla", "pipeline"],
+                     help="ensemble interior engine: 'xla' (vmapped "
+                     "parametric step — any flows, per-scenario rates) "
+                     "or 'pipeline' (the pipelined-window Pallas kernel "
+                     "per lane — all-Diffusion, one shared rate, grid "
+                     "divisible into 16x128 strips)")
     run.add_argument("--mesh", default=None,
                      help="LxC device mesh for sharded execution "
                      "(e.g. 4x1, 2x4); omit for serial")

@@ -38,6 +38,22 @@ from .cell import MOORE_OFFSETS, Cell, neighbor_count_grid
 DEFAULT_ATTR = "value"
 
 
+def first_float_dtype(values: Mapping[str, Any]):
+    """Dtype of the first FLOATING channel — the flow/transport dtype of
+    a mixed-dtype space — falling back to the first channel when none is
+    floating. The L0 seam supports int/bool STORAGE channels (e.g. a
+    land-water mask) beside the float channels flows act on; the
+    float-typed machinery (neighbor counts, conservation thresholds,
+    ``finfo``) must key off a float channel regardless of dict order."""
+    first = None
+    for v in values.values():
+        if first is None:
+            first = v.dtype
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            return v.dtype
+    return first
+
+
 @dataclasses.dataclass(frozen=True)
 class Partition:
     """One shard of the global grid: origin + extent (+ owner rank).
@@ -133,7 +149,7 @@ class CellularSpace:
     def create(
         dim_x: int,
         dim_y: int,
-        attributes: Union[None, float, Mapping[str, float]] = None,
+        attributes: Union[None, float, Mapping[str, Any]] = None,
         dtype: Any = jnp.float32,
         x_init: int = 0,
         y_init: int = 0,
@@ -142,16 +158,26 @@ class CellularSpace:
     ) -> "CellularSpace":
         """Build a dim_x × dim_y grid (or partition, when an origin/global
         dims are given) with every cell of every channel set to its init
-        value (reference seeds 1, ``Model.hpp:155``)."""
+        value (reference seeds 1, ``Model.hpp:155``).
+
+        A channel's entry in ``attributes`` may be a scalar init value
+        (stored in ``dtype``) or an ``(init, dtype)`` pair for
+        per-channel dtypes — the int/bool half of the L0 seam, e.g.
+        ``{"value": 1.0, "mask": (True, "bool")}`` for a land-water mask
+        channel beside the float flow channel."""
         jdt = to_jax(get_abstraction_data_type(dtype))
         if attributes is None:
             attributes = {DEFAULT_ATTR: 1.0}
         elif isinstance(attributes, (int, float)):
             attributes = {DEFAULT_ATTR: float(attributes)}
-        vals = {
-            name: jnp.full((dim_x, dim_y), init, dtype=jdt)
-            for name, init in attributes.items()
-        }
+        vals = {}
+        for name, init in attributes.items():
+            if isinstance(init, tuple):
+                iv, idt = init
+                cdt = to_jax(get_abstraction_data_type(idt))
+            else:
+                iv, cdt = init, jdt
+            vals[name] = jnp.full((dim_x, dim_y), iv, dtype=cdt)
         return CellularSpace(vals, dim_x, dim_y, x_init, y_init,
                              global_dim_x, global_dim_y)
 
@@ -184,7 +210,11 @@ class CellularSpace:
 
     @property
     def dtype(self):
-        return next(iter(self.values.values())).dtype
+        """The flow/transport dtype: the first FLOATING channel's dtype
+        (first channel when none is floating) — int/bool storage
+        channels never become the space's arithmetic dtype just by
+        dict order (see ``first_float_dtype``)."""
+        return first_float_dtype(self.values)
 
     def data_type(self) -> DataType:
         return get_abstraction_data_type(self.dtype)

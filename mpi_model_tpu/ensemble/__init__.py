@@ -1,0 +1,35 @@
+"""Ensemble engine: batched multi-scenario serving with a bucketed
+compile cache.
+
+- ``batch``     — EnsembleSpace (stacked SoA pytree, leading batch axis),
+                  the vmapped parametric step, per-scenario conservation,
+                  EnsembleExecutor (impl="xla" | "pipeline");
+- ``scheduler`` — scenario queue with bucketed batching (pad to bucket,
+                  max-wait/max-batch flush, runner cache + hit counters);
+- ``service``   — submit/poll facade with throughput counters.
+
+See docs/DESIGN.md "Ensemble serving" for why the batch axis sits
+OUTSIDE the mesh axes.
+"""
+
+from .batch import (
+    EnsembleConservationError,
+    EnsembleExecutor,
+    EnsembleSpace,
+    run_ensemble,
+    structure_key,
+)
+from .scheduler import DEFAULT_BUCKETS, EnsembleScheduler, buckets_for
+from .service import EnsembleService
+
+__all__ = [
+    "EnsembleConservationError",
+    "EnsembleExecutor",
+    "EnsembleScheduler",
+    "EnsembleService",
+    "EnsembleSpace",
+    "DEFAULT_BUCKETS",
+    "buckets_for",
+    "run_ensemble",
+    "structure_key",
+]

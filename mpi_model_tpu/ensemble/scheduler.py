@@ -1,0 +1,264 @@
+"""Scenario queue with bucketed batching — the compile-cache-aware
+dispatch policy of the ensemble engine.
+
+Submissions queue per STRUCTURE GROUP: everything that must match for
+two scenarios to ride one compiled program (``batch.structure_key`` —
+flow structure, offsets, geometry, channel dtypes) plus the step count,
+which every lane of one dispatch shares (the count itself is traced, so
+it never costs a compile — it is a grouping key only).
+
+A group flushes when it reaches ``max_batch`` scenarios, when its oldest
+submission has waited ``max_wait_s`` (checked at every ``pump``/
+``poll``), or on ``pump(force=True)``; due groups flush OLDEST-FIRST
+(the flush-on-max-wait ordering contract, tested). Each dispatch pads
+its k real scenarios up to the smallest configured BUCKET >= k with
+zero scenarios (``batch.padding_scenarios`` — zero values, zero rates:
+padded lanes contribute nothing to conservation or reports), so the
+runner cache — keyed by ``(bucket, shape, dtype, impl, substeps,
+structure)`` — sees a handful of batch shapes instead of one per
+traffic pattern: any load is served with at most ``len(buckets)``
+compiles per structure.
+
+``clock`` is injectable (tests drive the max-wait policy with a fake
+clock); wall times for the throughput counters always come from
+``time.perf_counter``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import time
+from typing import Callable, Optional, Sequence
+
+from ..core.cellular_space import CellularSpace
+from ..utils.metrics import ThroughputCounter
+from .batch import (EnsembleExecutor, padding_scenarios, run_ensemble,
+                    structure_key)
+
+#: default bucket ladder: pad k scenarios up to the smallest entry >= k
+DEFAULT_BUCKETS = (1, 2, 4, 8)
+
+
+def buckets_for(n: int) -> tuple[int, ...]:
+    """Power-of-two bucket ladder covering batches up to ``n``."""
+    out = [1]
+    while out[-1] < n:
+        out.append(out[-1] * 2)
+    return tuple(out)
+
+
+@dataclasses.dataclass
+class _Pending:
+    ticket: int
+    space: CellularSpace
+    model: object
+    steps: int
+    submitted_at: float
+
+
+class EnsembleScheduler:
+    """Bucketed-batching scenario queue (module docstring has the
+    policy). ``submit`` returns an integer ticket; ``poll(ticket)``
+    pumps due groups and returns ``(space, Report)`` when served,
+    ``None`` while queued, and raises the lane's
+    ``EnsembleConservationError`` (with ``.ticket`` attached) when that
+    scenario violated — a bad scenario never poisons its batchmates'
+    results (``run_ensemble(on_violation="mark")``)."""
+
+    def __init__(self, *, impl: str = "xla", substeps: int = 1,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 max_wait_s: float = 0.0, max_batch: Optional[int] = None,
+                 compute_dtype=None, check_conservation: bool = True,
+                 tolerance: float = 1e-3, rtol: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 counter: Optional[ThroughputCounter] = None):
+        bl = tuple(sorted({int(b) for b in buckets}))
+        if not bl or bl[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets}")
+        self.buckets = bl
+        self.max_batch = bl[-1] if max_batch is None else int(max_batch)
+        if not 1 <= self.max_batch <= bl[-1]:
+            raise ValueError(
+                f"max_batch={max_batch} outside [1, {bl[-1]}] (the "
+                "largest bucket bounds a dispatch)")
+        self.max_wait_s = float(max_wait_s)
+        self.executor = EnsembleExecutor(impl=impl, substeps=substeps,
+                                         compute_dtype=compute_dtype)
+        self.check_conservation = check_conservation
+        self.tolerance = tolerance
+        self.rtol = rtol
+        self.counter = counter if counter is not None else ThroughputCounter()
+        self._clock = clock
+        self._queues: collections.OrderedDict[tuple, list[_Pending]] = \
+            collections.OrderedDict()
+        self._results: dict[int, object] = {}
+        self._pending_tickets: set[int] = set()
+        self._ids = itertools.count()
+        #: one record per dispatch ({bucket, count, occupancy, steps,
+        #: tickets, cache_hit, wall_s}) — the observable flush order.
+        #: Bounded: a long-lived service must not grow a log forever
+        #: (ThroughputCounter carries the aggregates); the deque keeps
+        #: the most recent dispatches for debugging/tests.
+        self.dispatch_log: collections.deque = collections.deque(
+            maxlen=256)
+
+    # -- submission / results ------------------------------------------------
+
+    def submit(self, space: CellularSpace, model, steps: Optional[int] = None
+               ) -> int:
+        """Queue one scenario; returns its ticket. The group dispatches
+        immediately once it holds ``max_batch`` scenarios."""
+        steps = model.num_steps if steps is None else int(steps)
+        key = structure_key(model, space) + (steps,)
+        ticket = next(self._ids)
+        self._queues.setdefault(key, []).append(
+            _Pending(ticket, space, model, steps, self._clock()))
+        self._pending_tickets.add(ticket)
+        if len(self._queues[key]) >= self.max_batch:
+            self._dispatch(key)
+        return ticket
+
+    def poll(self, ticket: int):
+        """Result for ``ticket`` if served (due groups are pumped
+        first): ``(space, Report)``; ``None`` while queued; raises the
+        scenario's ``EnsembleConservationError`` on violation — or the
+        dispatch's error when its whole batch failed (e.g. an
+        ineligible engine); ``KeyError`` for unknown or
+        already-collected tickets. Failures surface HERE, per affected
+        ticket, never out of submit()/poll() on unrelated tickets."""
+        self.pump()
+        if ticket in self._results:
+            res = self._results.pop(ticket)
+            if isinstance(res, Exception):
+                raise res
+            return res
+        if ticket in self._pending_tickets:
+            return None
+        raise KeyError(f"unknown or already-collected ticket {ticket}")
+
+    # -- flush policy --------------------------------------------------------
+
+    def pump(self, force: bool = False) -> int:
+        """Dispatch every DUE group — full, or oldest submission waiting
+        >= ``max_wait_s`` (``force`` makes everything due) — oldest
+        head-of-queue first. Returns the number of dispatches."""
+        now = self._clock()
+        due = []
+        for key, q in self._queues.items():
+            if not q:
+                continue
+            if (force or len(q) >= self.max_batch
+                    or (now - q[0].submitted_at) >= self.max_wait_s):
+                due.append((q[0].submitted_at, q[0].ticket, key))
+        n = 0
+        for _, _, key in sorted(due):
+            while self._queues.get(key):
+                self._dispatch(key)
+                n += 1
+        return n
+
+    def drain(self) -> int:
+        """Force-flush until every queue is empty; returns dispatches."""
+        n = 0
+        while self._queues:
+            n += self.pump(force=True)
+        return n
+
+    def flush_ticket(self, ticket: int) -> int:
+        """Dispatch only the group holding ``ticket`` until that ticket
+        is served; OTHER groups keep accumulating toward their own
+        max-batch/max-wait flushes (one caller forcing its result must
+        not degrade every other tenant's batch occupancy). Returns the
+        number of dispatches."""
+        n = 0
+        while ticket in self._pending_tickets:
+            key = next((k for k, q in self._queues.items()
+                        if any(it.ticket == ticket for it in q)), None)
+            if key is None:  # pragma: no cover - pending implies queued
+                break
+            self._dispatch(key)
+            n += 1
+        return n
+
+    def _dispatch(self, key: tuple) -> None:
+        q = self._queues[key]
+        k = min(len(q), self.buckets[-1])
+        items, rest = q[:k], q[k:]
+        if rest:
+            self._queues[key] = rest
+        else:
+            del self._queues[key]
+        bucket = next(b for b in self.buckets if b >= k)
+        template = items[0].model
+        spaces = [it.space for it in items]
+        models = [it.model for it in items]
+        if bucket > k:
+            pspaces, pmodels = padding_scenarios(template, spaces[0],
+                                                 bucket - k)
+            spaces += pspaces
+            models += pmodels
+        builds0 = self.executor.builds
+        try:
+            results = run_ensemble(
+                template, spaces, models=models, executor=self.executor,
+                steps=items[0].steps,
+                check_conservation=self.check_conservation,
+                tolerance=self.tolerance, rtol=self.rtol, count=k,
+                on_violation="mark")
+        except Exception as e:
+            # a whole-dispatch failure (e.g. pipeline ineligibility)
+            # must not strand its tickets OR leak out of an unrelated
+            # caller: submit()/poll() on OTHER tickets keep working, and
+            # each affected ticket re-raises this error when polled
+            for it in items:
+                self._results[it.ticket] = e
+                self._pending_tickets.discard(it.ticket)
+            self.dispatch_log.append({
+                "bucket": bucket, "count": k, "occupancy": k / bucket,
+                "steps": items[0].steps,
+                "tickets": [it.ticket for it in items],
+                "cache_hit": False, "wall_s": 0.0,
+                "error": f"{type(e).__name__}: {e}",
+            })
+            return
+        cache_hit = self.executor.builds == builds0
+        # the batch wall time: from any served lane's Report, else from
+        # a marked violation (run_ensemble stamps it there too, so a
+        # dispatch whose every lane violated still bills its wall)
+        wall = 0.0
+        for res in results:
+            if not isinstance(res, Exception):
+                wall = res[1].wall_time_s
+                break
+            wall = getattr(res, "wall_time_s", 0.0) or wall
+        for it, res in zip(items, results):
+            if isinstance(res, Exception):
+                res.ticket = it.ticket
+            self._results[it.ticket] = res
+            self._pending_tickets.discard(it.ticket)
+        self.counter.record_dispatch(scenarios=k, bucket=bucket,
+                                     wall_s=wall, cache_hit=cache_hit)
+        self.dispatch_log.append({
+            "bucket": bucket, "count": k, "occupancy": k / bucket,
+            "steps": items[0].steps,
+            "tickets": [it.ticket for it in items],
+            "cache_hit": cache_hit, "wall_s": wall,
+        })
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Serving counters (``ThroughputCounter.snapshot``) + runner
+        cache accounting + queue depth."""
+        out = self.counter.snapshot()
+        out.update({
+            "runner_builds": self.executor.builds,
+            "runner_cache_hits": self.executor.cache_hits,
+            "pending": len(self._pending_tickets),
+            "impl": self.executor.impl,
+            "substeps": self.executor.substeps,
+            "buckets": list(self.buckets),
+        })
+        return out

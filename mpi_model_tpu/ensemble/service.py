@@ -1,0 +1,83 @@
+"""Submit/poll serving facade over the bucketed ensemble scheduler.
+
+The shape a traffic-serving deployment programs against: a service is
+constructed around a TEMPLATE model (the structure every submission must
+share — see ``batch.structure_key``); clients ``submit`` scenarios (a
+space, optionally a parameter-varied model and step count) and
+``poll``/``result`` their per-scenario ``Report``s back. Throughput
+accounting (scenarios/s, batch occupancy, compile-cache hits) runs
+through ``utils.metrics.ThroughputCounter`` and is surfaced by
+``stats()`` — the fields the CLI's ``--ensemble`` run and
+``bench.bench_ensemble`` publish.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence
+
+from ..core.cellular_space import CellularSpace
+from .scheduler import DEFAULT_BUCKETS, EnsembleScheduler
+
+
+class EnsembleService:
+    """submit/poll API over ``EnsembleScheduler``.
+
+    ``steps`` sets the default per-submission step count (falling back
+    to the template's ``time/time_step`` schedule); all other keyword
+    arguments configure the scheduler (impl, substeps, buckets,
+    max_wait_s, max_batch, conservation policy, clock).
+    """
+
+    def __init__(self, model, *, steps: Optional[int] = None,
+                 impl: str = "xla", substeps: int = 1,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 max_wait_s: float = 0.0, max_batch: Optional[int] = None,
+                 compute_dtype=None, check_conservation: bool = True,
+                 tolerance: float = 1e-3, rtol: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.model = model
+        self.default_steps = (model.num_steps if steps is None
+                              else int(steps))
+        self.scheduler = EnsembleScheduler(
+            impl=impl, substeps=substeps, buckets=buckets,
+            max_wait_s=max_wait_s, max_batch=max_batch,
+            compute_dtype=compute_dtype,
+            check_conservation=check_conservation, tolerance=tolerance,
+            rtol=rtol, clock=clock)
+
+    def submit(self, space: CellularSpace, *, model=None,
+               steps: Optional[int] = None) -> int:
+        """Queue one scenario; returns its ticket. ``model`` (default:
+        the template) may vary numeric flow parameters; its structure
+        must match the template's."""
+        m = self.model if model is None else model
+        return self.scheduler.submit(
+            space, m, self.default_steps if steps is None else int(steps))
+
+    def poll(self, ticket: int):
+        """(space, Report) when served, None while queued; raises the
+        scenario's ``EnsembleConservationError`` on violation."""
+        return self.scheduler.poll(ticket)
+
+    def result(self, ticket: int):
+        """Force THIS ticket's scenario through (flushing only its
+        structure group — other clients' partial batches keep
+        accumulating toward their own flush policies) and return its
+        (space, Report)."""
+        res = self.poll(ticket)
+        if res is None:
+            self.scheduler.flush_ticket(ticket)
+            res = self.poll(ticket)
+        if res is None:  # pragma: no cover - flush_ticket serves it
+            raise RuntimeError(f"ticket {ticket} still pending after flush")
+        return res
+
+    def flush(self) -> int:
+        """Dispatch everything queued; returns the dispatch count."""
+        return self.scheduler.drain()
+
+    def stats(self) -> dict:
+        """Serving counters: scenarios/s, batch occupancy, compile-cache
+        hits, dispatches, queue depth (``EnsembleScheduler.stats``)."""
+        return self.scheduler.stats()

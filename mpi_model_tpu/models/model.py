@@ -45,6 +45,16 @@ class ConservationError(AssertionError):
     """Mass-conservation contract violated (``Model.hpp:95``, with fabs)."""
 
 
+def default_conservation_rtol(shape: tuple[int, int], dtype) -> float:
+    """Default relative conservation tolerance ≈ 4·eps·log2(N): the
+    pairwise-summation error bound for XLA reductions. THE one copy of
+    the bound — ``Model.conservation_threshold`` (serial) and
+    ``ensemble.batch.conservation_thresholds`` (per-lane) both derive
+    from it, so the two paths cannot drift apart."""
+    n = max(shape[0] * shape[1], 2)
+    return 4.0 * float(jnp.finfo(dtype).eps) * math.log2(n)
+
+
 @dataclasses.dataclass
 class Report:
     """Run report — the live realization of the reference's vestigial
@@ -203,6 +213,7 @@ class Model:
             self.offsets = tuple(offsets)
         self._step_cache: dict = {}
         self._default_executor: Optional[SerialExecutor] = None
+        self._default_ensemble = None
 
     @property
     def flow(self) -> Flow:
@@ -276,10 +287,19 @@ class Model:
         ``bfloat16`` trades interior precision for VPU throughput; the
         near-ring exact path always computes in f32. The XLA path
         ignores it (its math runs in the storage dtype)."""
-        if not jnp.issubdtype(space.dtype, jnp.floating):
-            raise TypeError(
-                f"flow transport requires a floating dtype, got {space.dtype}"
-                " (integer channels are supported for storage/comm, not flows)")
+        for f in self.flows:
+            ch = space.values.get(f.attr)
+            if ch is None:
+                raise ValueError(
+                    f"flow {type(f).__name__} targets channel {f.attr!r} "
+                    f"which the space does not carry "
+                    f"(has {tuple(space.values)})")
+            if not jnp.issubdtype(ch.dtype, jnp.floating):
+                raise TypeError(
+                    f"flow transport requires a floating dtype, got "
+                    f"{ch.dtype} for channel {f.attr!r} (integer/bool "
+                    "channels are supported for storage/comm/masks, "
+                    "not flows)")
         if impl not in ("xla", "pallas", "auto", "composed"):
             raise ValueError(f"unknown step impl {impl!r}")
         substeps = int(substeps)
@@ -522,9 +542,7 @@ class Model:
         bound. Default rtol ≈ 4·eps·log2(N), the pairwise-summation error
         bound for XLA reductions."""
         if rtol is None:
-            n = max(space.dim_x * space.dim_y, 2)
-            eps = float(jnp.finfo(space.dtype).eps)
-            rtol = 4.0 * eps * math.log2(n)
+            rtol = default_conservation_rtol(space.shape, space.dtype)
         if initial_totals is None:
             initial_totals = {k: float(space.total(k)) for k in space.values}
         scale = max(abs(t) for t in initial_totals.values())
@@ -600,3 +618,43 @@ class Model:
                     f"{report.conservation_error():.3e} > {thresh:.3e} "
                     f"(initial={initial}, final={final})")
         return out_space, report
+
+    def execute_many(
+        self,
+        spaces,
+        *,
+        models=None,
+        executor=None,
+        steps: Optional[int] = None,
+        check_conservation: bool = True,
+        tolerance: float = 1e-3,
+        rtol: Optional[float] = None,
+    ) -> list:
+        """Run B independent scenarios as ONE batched device program
+        (the ensemble engine, ``ensemble.batch``); returns a list of
+        ``(space, Report)`` — one per scenario, matching B independent
+        ``SerialExecutor`` runs of the same scenarios.
+
+        ``models`` (default: this model for every lane) may vary NUMERIC
+        flow parameters per scenario — rates, frozen snapshots — but
+        must share this model's structure (flow types/attrs/sources,
+        offsets) and the spaces' geometry/channel dtypes; anything else
+        is a different compiled program and raises ``ValueError``.
+        ``executor`` is an ``ensemble.EnsembleExecutor``
+        (``impl="xla"`` — vmapped parametric step — or ``"pipeline"``,
+        the pipelined-window Pallas kernel per lane under ``lax.map``).
+
+        The conservation contract is enforced PER SCENARIO (a vmapped
+        reduction yields per-lane totals); a violation raises
+        ``ensemble.EnsembleConservationError`` carrying the failing
+        scenario's index instead of poisoning the batch aggregate."""
+        from ..ensemble.batch import EnsembleExecutor, run_ensemble
+
+        if executor is None:
+            if self._default_ensemble is None:
+                self._default_ensemble = EnsembleExecutor()
+            executor = self._default_ensemble
+        return run_ensemble(
+            self, spaces, models=models, executor=executor, steps=steps,
+            check_conservation=check_conservation, tolerance=tolerance,
+            rtol=rtol)

@@ -459,6 +459,21 @@ class ShardMapExecutor:
         # pointwise flows take the summed-outflow form, which XLA's FMA
         # contraction may round differently by ~1 ULP
         uniform_rates = model.pallas_rates()
+        # the general chunk pads and MASKS every channel in the flow
+        # dtype, which would silently float-ify int/bool storage
+        # channels (e.g. a land-water mask); the uniform chunk touches
+        # only its own rate-carrying float attrs, so bystanders are
+        # fine there
+        if uniform_rates is None:
+            nonfloat = sorted(
+                k for k, v in space.values.items()
+                if not jnp.issubdtype(v.dtype, jnp.floating))
+            if nonfloat:
+                raise ValueError(
+                    f"halo_depth > 1 with general pointwise flows pads/"
+                    f"masks every channel in the flow dtype; non-float "
+                    f"channels {nonfloat} are not supported on this path "
+                    "— use halo_depth=1 (or an all-Diffusion model)")
 
         mesh = self.mesh
         names, nx, ny, local_h, local_w = self._shard_geometry(space)

@@ -1,13 +1,16 @@
-from .metrics import (interleaved_ab, marginal_runner_time,
-                      marginal_runner_trials, marginal_step_time,
-                      marginal_step_trials, median_spread)
+from .metrics import (ThroughputCounter, interleaved_ab,
+                      marginal_runner_time, marginal_runner_trials,
+                      marginal_step_time, marginal_step_trials,
+                      median_spread, positive_spread)
 from .roofline import chip_peaks, stencil_roofline
 from .tracing import Span, Tracer, get_tracer, set_tracer, trace_span
 
 __all__ = [
+    "ThroughputCounter",
     "marginal_step_time",
     "marginal_step_trials",
     "median_spread",
+    "positive_spread",
     "marginal_runner_time",
     "marginal_runner_trials",
     "interleaved_ab",

@@ -171,6 +171,18 @@ def interleaved_ab(steps: dict, values: Values, *, s1: int = 5,
     return {name: statistics.median(ts) for name, ts in times.items()}
 
 
+def positive_spread(samples: list[float], scale: float) -> dict:
+    """{lo, hi} of ``scale / t`` over the POSITIVE samples — a noise
+    transient can make an individual marginal estimate non-positive,
+    and such samples carry no spread information (a negative per-step
+    time inverts into a negative throughput bound). Null fields when
+    none survive. The one implementation behind every cups/scenarios-
+    per-second spread the bench and ladder publish."""
+    pos = [s for s in samples if s > 0]
+    return {"lo": scale / max(pos) if pos else None,
+            "hi": scale / min(pos) if pos else None}
+
+
 def median_spread(samples: list[float]) -> dict:
     """{value: median, spread_lo: min, spread_hi: max} of the samples —
     the shape BENCH/ladder rows report so successive rounds don't read
@@ -179,6 +191,50 @@ def median_spread(samples: list[float]) -> dict:
 
     return {"value": statistics.median(samples),
             "spread_lo": min(samples), "spread_hi": max(samples)}
+
+
+class ThroughputCounter:
+    """Monotonic serving counters for the ensemble engine (scheduler /
+    service): scenarios served, dispatches, dispatched lanes (incl.
+    bucket padding), busy wall seconds, runner-cache hits.
+
+    ``snapshot()`` derives the serving metrics the bench/CLI publish:
+    ``scenarios_per_s`` (scenarios / busy seconds — DISPATCH wall only,
+    so queueing latency from a max-wait policy is not billed as
+    compute), ``batch_occupancy`` (real lanes / dispatched lanes — how
+    much of each padded bucket did real work) and
+    ``compile_cache_hit_rate`` (dispatches that reused a built runner).
+    """
+
+    def __init__(self):
+        self.dispatches = 0
+        self.scenarios = 0
+        self.lanes = 0
+        self.busy_s = 0.0
+        self.cache_hits = 0
+
+    def record_dispatch(self, scenarios: int, bucket: int, wall_s: float,
+                        cache_hit: bool) -> None:
+        self.dispatches += 1
+        self.scenarios += int(scenarios)
+        self.lanes += int(bucket)
+        self.busy_s += float(wall_s)
+        if cache_hit:
+            self.cache_hits += 1
+
+    def snapshot(self) -> dict:
+        return {
+            "dispatches": self.dispatches,
+            "scenarios": self.scenarios,
+            "scenarios_per_s": (self.scenarios / self.busy_s
+                                if self.busy_s > 0 else None),
+            "batch_occupancy": (self.scenarios / self.lanes
+                                if self.lanes else None),
+            "compile_cache_hits": self.cache_hits,
+            "compile_cache_hit_rate": (self.cache_hits / self.dispatches
+                                       if self.dispatches else None),
+            "busy_s": self.busy_s,
+        }
 
 
 def marginal_runner_time(make_output: Callable[[int], object],

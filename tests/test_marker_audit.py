@@ -1,0 +1,141 @@
+"""Marker audit (ISSUE 2 satellite): the tier-1 wall — the 870 s
+``-m "not slow"`` inner-loop profile ROADMAP.md pins — stays thin only
+if every test that spawns a subprocess or runs a multihost/multichip
+dryrun is marked ``slow``. This test enforces that STRUCTURALLY over the
+test sources, so a new test (say, an ensemble CLI rig) cannot silently
+re-fatten the inner loop: it either carries the marker or fails here.
+
+Heaviness is detected from the AST: a test function is heavy when it
+(or a module-local helper it calls, transitively) references the
+``subprocess`` module / ``Popen`` / ``pexpect``, or calls anything whose
+name contains ``dryrun`` (the multihost/multichip rigs spawn worker
+processes internally). Heavy tests must be marked slow — a
+``pytest.mark.slow`` decorator on the function/class or a module-level
+``pytestmark``."""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+TESTS_DIR = Path(__file__).resolve().parent
+
+#: referencing any of these names marks a function heavy
+HEAVY_NAMES = {"subprocess", "Popen", "pexpect"}
+#: calling anything whose name contains one of these marks it heavy
+HEAVY_NAME_PARTS = ("dryrun",)
+
+
+def _marks_slow(node: ast.AST) -> bool:
+    """True when the expression contains a ``...slow`` attribute (any
+    spelling of pytest.mark.slow, including parametrized/called forms
+    and marker lists)."""
+    return any(isinstance(n, ast.Attribute) and n.attr == "slow"
+               for n in ast.walk(node))
+
+
+def _directly_heavy(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name is None:
+            continue
+        if name in HEAVY_NAMES:
+            return True
+        if any(part in name for part in HEAVY_NAME_PARTS):
+            return True
+    return False
+
+
+def _called_names(fn: ast.AST) -> set[str]:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                out.add(f.attr)
+    return out
+
+
+def _audit_module(path: Path) -> list[str]:
+    tree = ast.parse(path.read_text(), filename=str(path))
+    module_slow = any(
+        isinstance(stmt, ast.Assign)
+        and any(isinstance(t, ast.Name) and t.id == "pytestmark"
+                for t in stmt.targets)
+        and _marks_slow(stmt.value)
+        for stmt in tree.body)
+
+    # module-local function defs (incl. methods), for one-level-deep
+    # transitive heaviness through helpers
+    funcs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, node)
+
+    heavy = {name for name, fn in funcs.items() if _directly_heavy(fn)}
+    changed = True
+    while changed:  # propagate through helper calls to a fixpoint
+        changed = False
+        for name, fn in funcs.items():
+            if name in heavy:
+                continue
+            if _called_names(fn) & heavy:
+                heavy.add(name)
+                changed = True
+
+    violations = []
+    if module_slow:
+        return violations
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not node.name.startswith("test_"):
+            continue
+        if node.name not in heavy:
+            continue
+        if any(_marks_slow(d) for d in node.decorator_list):
+            continue
+        violations.append(f"{path.name}::{node.name}")
+    return violations
+
+
+def test_subprocess_and_dryrun_tests_are_marked_slow():
+    violations = []
+    for path in sorted(TESTS_DIR.glob("test_*.py")):
+        if path.name == Path(__file__).name:
+            continue
+        violations.extend(_audit_module(path))
+    assert not violations, (
+        "these tests spawn subprocesses or run multihost/multichip "
+        "dryruns but are not marked slow — they would fatten the tier-1 "
+        "inner loop (mark them @pytest.mark.slow or set a module "
+        f"pytestmark): {violations}")
+
+
+def test_audit_detects_an_unmarked_heavy_test(tmp_path):
+    """The audit itself must actually catch offenders (a vacuous auditor
+    would defend nothing)."""
+    p = tmp_path / "test_fake.py"
+    p.write_text(
+        "import subprocess\n\n"
+        "def _helper():\n"
+        "    subprocess.run(['true'])\n\n"
+        "def test_spawns():\n"
+        "    _helper()\n\n"
+        "def test_light():\n"
+        "    assert True\n")
+    vio = _audit_module(p)
+    assert vio == ["test_fake.py::test_spawns"]
+    # marking it (or the module) silences the finding
+    p.write_text(
+        "import pytest, subprocess\n"
+        "pytestmark = pytest.mark.slow\n\n"
+        "def test_spawns():\n"
+        "    subprocess.run(['true'])\n")
+    assert _audit_module(p) == []
