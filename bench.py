@@ -464,6 +464,7 @@ def bench_ensemble(grid: int = 4096, B: int = 8, steps: int = 8,
 
     bsp = positive_spread(bs, B)
     ssp = positive_spread(ss, B)
+    occ = st["batch_occupancy"]
     row = {
         "metric": f"ensemble scenarios/s ({B}x {grid}^2 {dtype_name}, "
                   f"{steps} steps/scenario, {impl}, median of {trials})",
@@ -477,7 +478,14 @@ def bench_ensemble(grid: int = 4096, B: int = 8, steps: int = 8,
                              if bmed > 0 and smed > 0 else None),
         # cell-updates/s alongside scenarios/s (the ladder's common unit)
         "cups": (grid * grid * steps * B / bmed if bmed > 0 else None),
-        "batch_occupancy": st["batch_occupancy"],
+        "batch_occupancy": occ,
+        # per-dispatch padding waste (1 - occupancy) and the runner
+        # cache's build/hit counters, surfaced from the service stats
+        # into the published row (ISSUE 3 satellite — they used to live
+        # only in the ThroughputCounter)
+        "padding_waste": (1.0 - occ) if occ is not None else None,
+        "runner_builds": st["runner_builds"],
+        "runner_cache_hits": st["runner_cache_hits"],
         "compile_cache_hits": st["compile_cache_hits"],
         "compile_cache_hit_rate": st["compile_cache_hit_rate"],
         "dispatches": st["dispatches"],
@@ -488,6 +496,208 @@ def bench_ensemble(grid: int = 4096, B: int = 8, steps: int = 8,
               f"{row['seq_scenarios_per_s'] or float('nan'):.2f} "
               "sequential", file=sys.stderr)
     return row
+
+
+def _active_workload(grid: int, frac: float, dtype, rng):
+    """Point-source wavefront covering ~``frac`` of the domain: a zero
+    ocean with a centered random square of side ``grid*sqrt(frac)`` —
+    the state the reference's live workload reaches after the front has
+    swept that fraction of the grid."""
+    import math
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    side = max(1, int(round(grid * math.sqrt(frac))))
+    v = np.zeros((grid, grid), np.float32)
+    r0 = (grid - side) // 2
+    v[r0:r0 + side, r0:r0 + side] = rng.uniform(
+        0.5, 2.0, (side, side)).astype(np.float32)
+    return jnp.asarray(v, dtype)
+
+
+def bench_active(grid: int = 16384, dtype_name: str = "float32",
+                 fracs: tuple = (0.01, 0.05, 0.15), steps_dense: int = 3,
+                 steps_active: int = 20, trials: int = 3,
+                 verbose: bool = False) -> dict:
+    """The active-tile engine's speedup-vs-activity-fraction curve at
+    the timed geometry (ISSUE 3 acceptance row).
+
+    For each activity fraction, a point-source wavefront covering that
+    share of the domain is stepped through
+    ``SerialExecutor(step_impl="active")`` (the amortized runner: pad
+    once, O(active-tiles) per step) and compared against the DENSE
+    baseline — the fused Pallas path on silicon, the XLA stencil path
+    on a CPU rig (interpret-mode Pallas is not an honest baseline).
+    Rows report EFFECTIVE cell-updates/s (skipped zero cells count as
+    updated — identical simulation progress by the bitwise-exactness
+    argument), median of ``trials`` marginal estimates + spread.
+
+    Gates before any timing:
+
+    - **bitwise-at-f64** (when x64 is on — the standalone ``--active``
+      entry enables it): a multi-tile point-source run through the
+      active executor vs the dense XLA executor, exact array equality;
+    - **timed-geometry** gate: one step at ``grid``² in the bench dtype,
+      active vs dense, exact equality (the skip rule is bitwise at
+      every dtype, so no tolerance is granted);
+    - **fallback** gate: a wavefront above the activity threshold must
+      engage the dense fallback every step (``backend_report``) AND
+      match the dense path exactly.
+    """
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_model_tpu import CellularSpace, Diffusion, Model
+    from mpi_model_tpu.models.model import SerialExecutor
+    from mpi_model_tpu.ops.active import plan_for
+    from mpi_model_tpu.ops.pallas_stencil import resolve_interpret
+    from mpi_model_tpu.utils import marginal_runner_trials, positive_spread
+
+    enable_compile_cache()
+    dtype = jnp.dtype(dtype_name)
+    rng = np.random.default_rng(42)
+    model = Model(Diffusion(RATE), 1.0, 1.0)
+    plan = plan_for((grid, grid))
+    on_cpu = resolve_interpret(jnp.zeros((1,), dtype))
+    dense_impl = "xla" if on_cpu else "auto"
+
+    def make_space(g, frac, dt):
+        return CellularSpace.create(g, g, 0.0, dtype=dt).with_values(
+            {"value": _active_workload(g, frac, dt, rng)})
+
+    # gate 1: bitwise at f64 on a multi-tile point-source run (needs
+    # jax_enable_x64; reported honestly as skipped otherwise)
+    gate_f64 = None
+    if jax.config.jax_enable_x64:
+        sp = make_space(1024, 0.02, jnp.float64)
+        oa, _ = model.execute(sp, SerialExecutor(step_impl="active"),
+                              steps=12, check_conservation=False)
+        ox, _ = model.execute(sp, SerialExecutor(step_impl="xla"),
+                              steps=12, check_conservation=False)
+        gate_f64 = bool(np.array_equal(np.asarray(oa.values["value"]),
+                                       np.asarray(ox.values["value"])))
+        if not gate_f64:
+            raise AssertionError(
+                "active-tile f64 gate failed: active executor output is "
+                "not bitwise equal to the dense XLA path at 1024^2")
+        if verbose:
+            print("  active f64 gate OK (bitwise, 1024^2, 12 steps)",
+                  file=sys.stderr)
+
+    # gate 2 + rows at the timed geometry
+    space = make_space(grid, fracs[0], dtype)
+    dense_ex = SerialExecutor(step_impl=dense_impl)
+    active_ex = SerialExecutor(step_impl="active")
+    got_a, _ = model.execute(space, active_ex, steps=1,
+                             check_conservation=False)
+    got_d, _ = model.execute(space, dense_ex, steps=1,
+                             check_conservation=False)
+    if dense_ex.last_impl == "xla":
+        if not np.array_equal(np.asarray(got_a.values["value"]),
+                              np.asarray(got_d.values["value"])):
+            raise AssertionError(
+                f"active-tile timed-geometry gate failed at {grid}^2 "
+                f"{dtype_name}: active step != dense step bitwise")
+    else:
+        # pallas dense computes f32 interiors — tolerance gate instead
+        err = _max_err(got_a.values["value"], got_d.values["value"])
+        tol = _tol_for(1, dtype_name)
+        if err > tol:
+            raise AssertionError(
+                f"active-tile timed-geometry gate failed at {grid}^2 vs "
+                f"the fused kernel: max|err|={err:.3e} > {tol:.1e}")
+    if verbose:
+        print(f"  active timed-geometry gate OK ({grid}^2 {dtype_name} "
+              f"vs {dense_ex.last_impl})", file=sys.stderr)
+
+    # dense baseline: activity-independent, measured once
+    def dense_run(n):
+        model.execute(space, dense_ex, steps=n, check_conservation=False)
+
+    dense_run(1)
+    ds = marginal_runner_trials(dense_run, s1=1, s2=1 + steps_dense,
+                                trials=trials)
+    dmed = statistics.median(ds)
+    dsp = positive_spread(ds, grid * grid)
+    if verbose:
+        print(f"  dense ({dense_ex.last_impl}): {dmed*1e3:.1f} ms/step",
+              file=sys.stderr)
+
+    rows = []
+    for frac in fracs:
+        sp = make_space(grid, frac, dtype)
+
+        def arun(n, _sp=sp):
+            model.execute(_sp, active_ex, steps=n,
+                          check_conservation=False)
+
+        arun(1)
+        as_ = marginal_runner_trials(arun, s1=2, s2=2 + steps_active,
+                                     trials=trials)
+        amed = statistics.median(as_)
+        rep = active_ex.last_backend_report or {}
+        asp = positive_spread(as_, grid * grid)
+        rows.append({
+            "frac": frac,
+            "active_step_ms": amed * 1e3 if amed > 0 else None,
+            "active_cups_spread": [asp["lo"], asp["hi"]],
+            "eff_cups": grid * grid / amed if amed > 0 else None,
+            "speedup_vs_dense": (dmed / amed
+                                 if amed > 0 and dmed > 0 else None),
+            "fallback_steps": rep.get("fallback_steps"),
+            "mean_active_fraction": rep.get("mean_active_fraction"),
+        })
+        if verbose:
+            r = rows[-1]
+            print(f"  frac={frac}: {r['active_step_ms'] or float('nan'):.2f}"
+                  f" ms/step, speedup {r['speedup_vs_dense'] or 0:.1f}x "
+                  f"(fallback {r['fallback_steps']})", file=sys.stderr)
+
+    # gate 3: above-threshold wavefront must fall back AND match
+    # (reuses active_ex — same cache key, no redundant trace+compile;
+    # the fallback record rides the returned Report, not the instance)
+    sp = make_space(grid, 0.6, dtype)
+    ofb, rfb = model.execute(sp, active_ex, steps=1,
+                             check_conservation=False)
+    odn, _ = model.execute(sp, dense_ex, steps=1, check_conservation=False)
+    fb = (rfb.backend_report or {}).get("fallback_steps", 0)
+    fb_match = (bool(np.array_equal(np.asarray(ofb.values["value"]),
+                                    np.asarray(odn.values["value"])))
+                if dense_ex.last_impl == "xla" else
+                _max_err(ofb.values["value"], odn.values["value"])
+                <= _tol_for(1, dtype_name))
+    if fb < 1 or not fb_match:
+        raise AssertionError(
+            f"active-tile fallback gate failed: fallback_steps={fb}, "
+            f"matches_dense={fb_match} for an above-threshold wavefront")
+    if verbose:
+        print("  active fallback gate OK (engaged + matches dense)",
+              file=sys.stderr)
+
+    best = max((r for r in rows if r["speedup_vs_dense"]),
+               key=lambda r: r["speedup_vs_dense"], default=None)
+    return {
+        "metric": f"active-tile effective cell-updates/s vs dense "
+                  f"({grid}^2 {dtype_name}, point-source wavefront, "
+                  f"median of {trials})",
+        "grid": grid, "dtype": dtype_name,
+        "tile": list(plan.tile), "tiles": plan.ntiles,
+        "capacity": plan.capacity,
+        "dense_impl": dense_ex.last_impl,
+        "dense_step_ms": dmed * 1e3 if dmed > 0 else None,
+        "dense_cups": grid * grid / dmed if dmed > 0 else None,
+        "dense_cups_spread": [dsp["lo"], dsp["hi"]],
+        "trials": trials,
+        "gate_bitwise_f64": gate_f64,
+        "fallback_gate": {"engaged_steps": int(fb),
+                          "matches_dense": bool(fb_match)},
+        "rows": rows,
+        "best_speedup": best["speedup_vs_dense"] if best else None,
+    }
 
 
 def bench_halo_mode(space, model, dense_step, substeps: int,
@@ -680,7 +890,14 @@ def bench(grid: int = 16384, dtype_name: str = "bfloat16",
 
 if __name__ == "__main__":
     try:
-        result = bench(verbose="-v" in sys.argv)
+        if "--active" in sys.argv:
+            # the active-tile row stands alone: it runs on a CPU rig
+            # (the dense XLA baseline) when the tunnel chip is
+            # unreachable, and wants x64 for the bitwise-at-f64 gate
+            os.environ.setdefault("JAX_ENABLE_X64", "true")
+            result = bench_active(verbose="-v" in sys.argv)
+        else:
+            result = bench(verbose="-v" in sys.argv)
     except Exception as e:  # noqa: BLE001 — single-line contract
         print(json.dumps({"metric": "bench failed", "value": 0.0,
                           "unit": "error", "vs_baseline": 0.0,

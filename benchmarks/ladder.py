@@ -18,7 +18,11 @@ Configs (BASELINE.md):
   5. 16384^2 Moore-8 fused Pallas kernel               [tpu single chip; the
      multi-host v4-32 config scaled to the hardware this rig has]
   6. 2048^2x8 batched ensemble serving                 [scenarios/s + batch
-     occupancy + compile-cache hits vs the sequential baseline]
+     occupancy + padding waste + runner-cache builds/hits vs the
+     sequential baseline]
+  7. 16384^2 active-tile stepping                      [effective
+     cell-updates/s vs dense by activity fraction; point-source
+     wavefront workload]
 
 Host-rig (vCPU mesh) rows carry the SAME median-of-trials + spread
 fields as the silicon rows (round-5 VERDICT weak #2): a number without a
@@ -757,8 +761,28 @@ def config6(quick: bool = False) -> dict:
             **row}
 
 
+def config7(quick: bool = False) -> dict:
+    """Active-tile stepping (ISSUE 3): effective cell-updates/s vs the
+    dense path on a point-source wavefront, by activity fraction —
+    the skip-the-quiet-ocean economics at the timed 16384² geometry.
+    On a CPU rig the dense baseline is the XLA stencil path (honest:
+    interpret-mode Pallas is not a baseline); a tunnel-connected run
+    measures the fused kernel baseline automatically."""
+    import bench as bench_mod
+
+    g = 256 if quick else 16384
+    row = bench_mod.bench_active(
+        grid=g, fracs=(0.05,) if quick else (0.01, 0.05, 0.15),
+        steps_dense=2 if quick else 3,
+        steps_active=5 if quick else 20,
+        trials=1 if quick else 3)
+    return {"config": 7, "flow": "diffusion (point-source wavefront)",
+            "strategy": "active-tile stepping vs dense",
+            **row}
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6}
+           6: config6, 7: config7}
 
 
 def sweep_blocks(grid: int = 8192, dtype_name: str = "bfloat16") -> list:
@@ -792,7 +816,7 @@ def sweep_blocks(grid: int = 8192, dtype_name: str = "bfloat16") -> list:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--configs", default="1,2,3,4,5,6",
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7",
                     help="comma-separated ladder config numbers")
     ap.add_argument("--quick", action="store_true",
                     help="tiny shapes (smoke test, numbers meaningless)")

@@ -294,7 +294,7 @@ def cmd_run(args) -> int:
         if args.impl != "auto":
             raise SystemExit(
                 "--impl selects the single-run kernel; ensemble runs "
-                "use --ensemble-impl=xla|pipeline")
+                "use --ensemble-impl=xla|pipeline|active")
     elif args.ensemble_impl != "xla":
         raise SystemExit("--ensemble-impl applies to ensemble runs; "
                          "add --ensemble=B")
@@ -488,11 +488,16 @@ def main(argv: Optional[list[str]] = None) -> int:
     run.add_argument("--dtype", default="float32",
                      choices=["float32", "float64", "bfloat16"])
     run.add_argument("--impl", default="auto",
-                     choices=["xla", "pallas", "auto", "composed"],
+                     choices=["xla", "pallas", "auto", "composed",
+                              "active"],
                      help="field-flow kernel: 'composed' runs the "
                      "k-step composed tap filter (uniform-rate "
                      "Diffusion only; pair with --substeps=k serially "
-                     "or --halo-depth=k sharded)")
+                     "or --halo-depth=k sharded); 'active' runs the "
+                     "active-tile engine (compute only tiles whose "
+                     "ring-1 neighborhood holds mass — bitwise-exact "
+                     "skipping for uniform-rate Diffusion, dense "
+                     "fallback above the activity threshold)")
     run.add_argument("--compute-dtype", default=None,
                      choices=["float32", "bfloat16"],
                      help="Pallas interior-tile math dtype (default f32; "
@@ -507,12 +512,14 @@ def main(argv: Optional[list[str]] = None) -> int:
                      "conservation); reports scenarios/s, batch "
                      "occupancy and compile-cache hits")
     run.add_argument("--ensemble-impl", default="xla",
-                     choices=["xla", "pipeline"],
+                     choices=["xla", "pipeline", "active"],
                      help="ensemble interior engine: 'xla' (vmapped "
-                     "parametric step — any flows, per-scenario rates) "
-                     "or 'pipeline' (the pipelined-window Pallas kernel "
+                     "parametric step — any flows, per-scenario rates), "
+                     "'pipeline' (the pipelined-window Pallas kernel "
                      "per lane — all-Diffusion, one shared rate, grid "
-                     "divisible into 16x128 strips)")
+                     "divisible into 16x128 strips), or 'active' (the "
+                     "active-tile engine per lane — all-Diffusion, "
+                     "per-scenario rates and per-scenario activity)")
     run.add_argument("--mesh", default=None,
                      help="LxC device mesh for sharded execution "
                      "(e.g. 4x1, 2x4); omit for serial")

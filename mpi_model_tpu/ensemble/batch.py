@@ -50,7 +50,7 @@ import numpy as np
 from ..core.cellular_space import CellularSpace, first_float_dtype
 from ..models.model import (ConservationError, Model, Report,
                             default_conservation_rtol)
-from ..ops.flow import PointFlow, build_outflow
+from ..ops.flow import Diffusion, PointFlow, build_outflow
 from ..ops.stencil import neighbor_counts_traced, point_flow_step, transport
 
 Values = dict[str, jax.Array]
@@ -368,6 +368,9 @@ class EnsembleExecutor:
       kernel's rate is compile-time static), an f32/bf16 grid divisible
       into 16-row/128-col strips, and ``substeps <= 8``; raises
       ``ValueError`` otherwise (opt-in — no silent fallback).
+    - ``"active"``: the active-tile engine per lane (``ops.active``,
+      ISSUE 3) — each scenario skips its own quiet ocean; all-Diffusion
+      batches with per-lane rates (any float dtype, f64 included).
 
     ``substeps`` fuses that many model steps per compiled step call
     (kernel-fused on the pipeline path; composed singles on the XLA
@@ -382,15 +385,17 @@ class EnsembleExecutor:
 
     def __init__(self, impl: str = "xla", substeps: int = 1,
                  compute_dtype=None):
-        if impl not in ("xla", "pipeline"):
+        if impl not in ("xla", "pipeline", "active"):
             raise ValueError(
-                f"unknown ensemble impl {impl!r} (expected 'xla' or "
-                "'pipeline')")
+                f"unknown ensemble impl {impl!r} (expected 'xla', "
+                "'pipeline' or 'active')")
         self.impl = impl
         self.substeps = max(1, int(substeps))
         #: interior-tile math dtype for the pipeline kernel (None → f32)
         self.compute_dtype = compute_dtype
         self.last_impl: Optional[str] = None
+        #: per-run report detail (impl="active" stats); None otherwise
+        self.last_backend_report: Optional[dict] = None
         self._cache: dict = {}
         #: runner-build / cache-hit counters (the scheduler's
         #: compile-cache-hit fields read these)
@@ -412,6 +417,8 @@ class EnsembleExecutor:
         self.builds += 1
         if self.impl == "pipeline":
             runner = self._build_pipeline(model, espace, uniform_rates)
+        elif self.impl == "active":
+            runner = self._build_active(model, espace)
         else:
             runner = self._build_xla(model, espace)
         self._cache[key] = runner
@@ -465,6 +472,64 @@ class EnsembleExecutor:
             fn = jax.jit(jax.vmap(single, in_axes=(0, 0, 0)))
             self._cache[key] = fn
         return fn
+
+    def _build_active(self, model, espace: EnsembleSpace):
+        """Per-scenario ACTIVITY (ISSUE 3): each lane runs the
+        active-tile whole-run stepper (``ops.active`` — pad once, carry
+        the tile map, compute only active tiles, dense-fallback above
+        the threshold) under ``lax.map``, so one lane's quiet ocean is
+        skipped regardless of its batchmates' wavefronts, and each lane
+        conds on its OWN activity (under ``vmap`` the cond would
+        degenerate to computing both branches for every lane).
+
+        All-Diffusion scenario batches only; per-lane rates ride the
+        traced ``[B, F]`` parameter lanes like the XLA engine's. A lane
+        with a SINGLE Diffusion per channel reproduces the serial run
+        bitwise (channels fed by several flows sum rates before the
+        multiply, ~1 ULP from the serial summed-outflow grouping)."""
+        from ..ops import active as act
+
+        flows = list(model.flows)
+        if not flows or any(type(f) is not Diffusion for f in flows):
+            raise ValueError(
+                "impl='active' supports all-Diffusion scenario batches "
+                "(the tile-skip rule is only bitwise-exact for "
+                "uniform-rate linear flows); got "
+                f"flows={[type(f).__name__ for f in flows]}. "
+                "Use impl='xla'.")
+        for f in flows:
+            adt = espace.values[f.attr].dtype
+            if not jnp.issubdtype(adt, jnp.floating):
+                raise TypeError(
+                    f"flow transport requires a floating dtype, got "
+                    f"{adt} for channel {f.attr!r}")
+            if adt != jnp.dtype(espace.dtype):
+                raise ValueError(
+                    "impl='active' computes every flow channel in the "
+                    f"space dtype ({jnp.dtype(espace.dtype).name}); "
+                    f"channel {f.attr!r} is {adt}. Use impl='xla'.")
+        attr_idx: dict[str, list[int]] = {}
+        for i, f in enumerate(flows):
+            attr_idx.setdefault(f.attr, []).append(i)
+        lane = act.build_active_runner(
+            espace.shape, attr_idx, model.offsets, espace.dtype,
+            traced_rates=True)
+        substeps = self.substeps
+
+        def run(vb, rates_b, frozens_b, q, r):
+            n = q * np.int32(substeps) + r
+
+            def one(args):
+                v, rlane = args
+                return lane(v, n, rlane)
+
+            # stats ride out as [B] lanes: a batch that dense-fell-back
+            # every step must not be silently labeled "active"
+            # (run_ensemble folds them into backend_report — the same
+            # honesty contract as the serial and sharded runners)
+            return jax.lax.map(one, (vb, rates_b))
+
+        return jax.jit(run)
 
     def _build_pipeline(self, model, espace: EnsembleSpace,
                         rates: Optional[dict]):
@@ -602,8 +667,40 @@ def run_ensemble(model, spaces, *, models=None, executor=None, steps=None,
                  jnp.int32(q), jnp.int32(r))
     out = jax.tree.map(jax.block_until_ready, out)
     wall = _time.perf_counter() - t0
+    # the active engine's runner returns ([B] fallback-event,
+    # [B] active-tile) stat lanes alongside the values; fold them into
+    # backend_report so a batch that dense-fell-back every step is
+    # visible, not silently labeled "active" (serial/sharded contract)
+    fb_arr = at_arr = None
+    if executor.impl == "active":
+        out, (fb_b, at_b) = out
+        fb_arr = np.asarray(fb_b)
+        at_arr = np.asarray(at_b)
     final_d = batched_totals(out)
     executor.last_impl = executor.impl
+    executor.last_backend_report = None
+    if fb_arr is not None:
+        from ..ops.active import plan_for
+
+        plan = plan_for(espace.shape)
+        nattr = len({f.attr for f in model.flows})
+        denom = num_steps * nattr * plan.ntiles
+        executor.last_backend_report = {
+            "impl": "active",
+            "steps": num_steps,
+            "lanes": count,
+            #: (attr, step) dense-fallback events summed over REAL lanes
+            #: (padding lanes are identically zero and never fall back)
+            "fallback_steps": int(fb_arr[:count].sum()),
+            "per_lane_fallback_steps": [int(x) for x in fb_arr[:count]],
+            "tile": list(plan.tile),
+            "tiles": plan.ntiles,
+            "capacity": plan.capacity,
+            "fallback_tiles": plan.fallback_tiles,
+            "mean_active_fraction": (
+                float(at_arr[:count].sum()) / (count * denom)
+                if count and denom else None),
+        }
 
     last_exec = np.asarray(
         executor.last_execute_for(model, espace)(out, rates_b, frozens_b),
@@ -642,5 +739,11 @@ def run_ensemble(model, spaces, *, models=None, executor=None, steps=None,
             final_total={k: float(final[k][i]) for k in final},
             last_execute=[float(x) for x in last_exec[i]],
             wall_time_s=wall,
+            backend_report=(None if fb_arr is None else {
+                "impl": "active",
+                "fallback_steps": int(fb_arr[i]),
+                "mean_active_fraction": (
+                    float(at_arr[i]) / denom if denom else None),
+            }),
         )))
     return results

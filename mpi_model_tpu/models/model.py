@@ -105,19 +105,29 @@ class SerialExecutor:
 
     ``step_impl`` selects the per-step kernel: ``"xla"`` (fused stencil
     ops), ``"pallas"`` (the fused TPU kernel — Diffusion-only field flows),
-    or ``"auto"`` (pallas when eligible). ``substeps`` batches that many
-    model steps into each compiled step call (``Model.make_step``'s
-    multi-step fusion — the HBM-amortizing fast path on TPU); any
-    remainder of ``num_steps`` runs as single steps, so semantics are
-    independent of the setting.
+    ``"active"`` (the active-tile engine, ``ops.active`` — all-Diffusion
+    models run the amortized whole-run active stepper: pad once, carry
+    the tile map across steps, compute only active tiles; per-step dense
+    fallbacks and the measured activity land in
+    ``Report.backend_report``), or ``"auto"`` (pallas when eligible).
+    ``substeps`` batches that many model steps into each compiled step
+    call (``Model.make_step``'s multi-step fusion — the HBM-amortizing
+    fast path on TPU); any remainder of ``num_steps`` runs as single
+    steps, so semantics are independent of the setting.
+
+    ``active_opts`` tunes the active engine (keys ``tile``,
+    ``capacity``, ``max_active_frac`` — see ``ops.active.plan_for``).
     """
 
     comm_size = 1
 
     def __init__(self, step_impl: str = "xla", substeps: int = 1,
-                 compute_dtype=None):
+                 compute_dtype=None, active_opts: Optional[dict] = None):
         self.step_impl = step_impl
         self.substeps = max(1, int(substeps))
+        #: active-tile engine knobs (ops.active.plan_for); ignored by
+        #: the other impls
+        self.active_opts = active_opts
         #: interior-tile window math dtype for the Pallas kernels
         #: (None → f32; ``Model.make_step(compute_dtype=...)``); the XLA
         #: path ignores it
@@ -126,15 +136,23 @@ class SerialExecutor:
         #: "auto" fallback — the CLI/bench report it so a user never
         #: believes they measured a configuration that never ran
         self.last_impl: Optional[str] = None
+        #: per-run report detail (Report.backend_report); None until a
+        #: run records one
+        self.last_backend_report: Optional[dict] = None
         self._cache: dict = {}
 
     def run_model(self, model: "Model", space: CellularSpace,
                   num_steps: int) -> Values:
+        #: per-run report detail (Report.backend_report) — reset so a
+        #: previous run's composed/active record never leaks forward
+        self.last_backend_report = None
         # all-point-flow models step only the ≤9k involved cells in the
         # compiled loop (one O(grid) gather/scatter per RUN, bitwise
         # equal to the full-grid path) — the reference's live workload
         # (Main.cpp:32-33) at µs-step grids beat a NumPy loop this way
-        if (self.step_impl in ("xla", "auto") and num_steps > 0
+        # ("active" included: the point subsystem IS the ultimate
+        # active-set optimization for all-point models)
+        if (self.step_impl in ("xla", "auto", "active") and num_steps > 0
                 and model.flows
                 and all(isinstance(f, PointFlow) for f in model.flows)):
             from ..ops.point_kernel import build_point_plans, \
@@ -160,6 +178,81 @@ class SerialExecutor:
                 self.last_impl = "point"
                 return runner(dict(space.values), jnp.int32(num_steps))
 
+        # the amortized active-tile runner (ops.active): pads once and
+        # carries the tile map + update buffer across the WHOLE run, so
+        # per-step work is O(active tiles), never O(grid) — the engine
+        # ISSUE 3 builds. All-Diffusion models only (the skip rule's
+        # exactness argument); models with point flows or other field
+        # flows drop to the generic loop below, whose stateless
+        # make_step(impl="active") form recomputes activity per step.
+        if self.step_impl == "active" and num_steps > 0:
+            rates = model.pallas_rates()
+            live = {a: r for a, r in (rates or {}).items() if r != 0.0}
+            # the amortized runner computes every live channel in
+            # space.dtype: a non-float or off-space-dtype flow channel
+            # drops to the generic loop, whose make_step raises the
+            # clean "requires a floating dtype" TypeError / "computes
+            # every flow channel in the space dtype" ValueError instead
+            # of a mid-trace lax dtype mismatch
+            if (rates is not None and live
+                    and not any(isinstance(f, PointFlow)
+                                for f in model.flows)
+                    and all(jnp.issubdtype(space.values[a].dtype,
+                                           jnp.floating)
+                            and space.values[a].dtype == jnp.dtype(
+                                space.dtype)
+                            for a in live)):
+                key = ("activerun", space.shape, space.global_shape,
+                       (space.x_init, space.y_init), str(space.dtype),
+                       model.offsets, tuple(sorted(live.items())),
+                       tuple(sorted((self.active_opts or {}).items())))
+                entry = self._cache.get(key)
+                if entry is None:
+                    from ..ops.active import build_active_runner, plan_for
+
+                    opts = dict(self.active_opts or {})
+                    plan = plan_for(
+                        space.shape, tile=opts.get("tile"),
+                        capacity=opts.get("capacity"),
+                        max_active_frac=opts.get("max_active_frac", 0.25))
+                    # fallback steps run the fused dense kernel where it
+                    # actually compiles+runs here, else the bitwise XLA
+                    # transport (ops.active.dense_from_padded)
+                    dense_fns = {}
+                    for a, r in live.items():
+                        fn = model._probe_pallas_dense(space, r,
+                                                       self.compute_dtype)
+                        if fn is not None:
+                            dense_fns[a] = fn
+                    run = jax.jit(build_active_runner(
+                        space.shape, live, model.offsets, space.dtype,
+                        origin=(space.x_init, space.y_init),
+                        global_shape=space.global_shape, plan=plan,
+                        dense_fns=dense_fns))
+                    entry = (run, plan)
+                    self._cache[key] = entry
+                run, plan = entry
+                out, (fb, at) = run(dict(space.values),
+                                    jnp.int32(num_steps))
+                self.last_impl = "active"
+                nattr = len(live)
+                self.last_backend_report = {
+                    "impl": "active",
+                    "steps": int(num_steps),
+                    #: (attr, step) pairs that ran the dense fallback —
+                    #: the honest record that the engine measured is the
+                    #: one that ran (executors.py point-routing pattern)
+                    "fallback_steps": int(fb),
+                    "tile": list(plan.tile),
+                    "tiles": plan.ntiles,
+                    "capacity": plan.capacity,
+                    "fallback_tiles": plan.fallback_tiles,
+                    "mean_active_fraction": (
+                        float(at) / (num_steps * nattr * plan.ntiles)
+                        if num_steps and nattr else None),
+                }
+                return out
+
         # q multi-step calls + r single-step calls == num_steps steps
         q, r = divmod(num_steps, self.substeps)
         stepk = model.make_step(space, impl=self.step_impl,
@@ -172,6 +265,21 @@ class SerialExecutor:
         step_any = stepk or step1
         # num_steps=0 builds no step at all — nothing ran, report None
         self.last_impl = step_any.impl if step_any is not None else None
+        if step_any is not None and step_any.impl == "composed":
+            # auto-k visibility (ISSUE 3 satellite): the chosen k and
+            # the remainder chunk's depth land in Report.backend_report,
+            # so impl="composed" silently equaling the iterated path
+            # (k=1) is observable, not inferred
+            self.last_backend_report = {
+                "impl": "composed",
+                "substeps": self.substeps,
+                "composed_k": getattr(stepk or step1, "composed_k", None),
+                "composed_passes_per_call": getattr(
+                    stepk or step1, "composed_passes", None),
+                "remainder_steps": r,
+                "remainder_k": (getattr(step1, "composed_k", None)
+                                if step1 is not None else None),
+            }
         # the trip counts are TRACED scalars, so the cache key is only
         # which steps exist: chunked/supervised runs of any size reuse
         # one compile (at most 3 variants: k-only, 1-only, k+1)
@@ -240,6 +348,36 @@ class Model:
             rates[f.attr] = rates.get(f.attr, 0.0) + f.flow_rate
         return rates
 
+    def _probe_pallas_dense(self, space: CellularSpace, rate: float,
+                            compute_dtype=None):
+        """The fused dense kernel as an ACTIVE-path fallback stepper —
+        returned only when this process would actually run it compiled
+        (interpret mode makes it a perf trap on CPU rigs, and the
+        bitwise-at-f64 contract needs the XLA transport there anyway).
+        Probed eagerly on zeros so a kernel that cannot compile degrades
+        to the XLA dense path instead of exploding inside the caller's
+        jit (the same discipline as impl='auto'). None → use the
+        bitwise XLA transport."""
+        from ..ops.pallas_stencil import PallasDiffusionStep, \
+            resolve_interpret
+
+        if (space.is_partition or not self.pallas_dtype_ok(space)
+                or resolve_interpret(next(iter(space.values.values())))):
+            return None
+        try:
+            stepper = PallasDiffusionStep(
+                space.shape, rate, dtype=space.dtype, offsets=self.offsets,
+                interpret=False, compute_dtype=compute_dtype)
+            jax.block_until_ready(
+                stepper(jnp.zeros(space.shape, space.dtype)))
+        except Exception as e:
+            warnings.warn(
+                f"Pallas dense fallback failed ({e!r}); the active "
+                "engine will fall back to the XLA transport instead",
+                RuntimeWarning)
+            return None
+        return stepper
+
     @staticmethod
     def pallas_dtype_ok(space: CellularSpace) -> bool:
         """Pallas kernels compute in f32 internally; f64 grids stay on
@@ -300,7 +438,7 @@ class Model:
                     f"{ch.dtype} for channel {f.attr!r} (integer/bool "
                     "channels are supported for storage/comm/masks, "
                     "not flows)")
-        if impl not in ("xla", "pallas", "auto", "composed"):
+        if impl not in ("xla", "pallas", "auto", "composed", "active"):
             raise ValueError(f"unknown step impl {impl!r}")
         substeps = int(substeps)
         if substeps < 1:
@@ -361,17 +499,71 @@ class Model:
                     "step_impl='composed', halo_depth=k) for sharded "
                     "runs.")
             from ..ops.composed_stencil import (ComposedDiffusionStep,
-                                               choose_k)
+                                               choose_k, max_k)
             from ..ops.pallas_stencil import resolve_interpret
             interp = resolve_interpret(next(iter(space.values.values())))
             ck = choose_k(substeps, space.shape, space.dtype)
             composed_passes = substeps // ck
+            if ck == 1 and substeps > 1:
+                # auto-k degenerated (prime substeps beyond the window's
+                # composable depth): every "composed" call is substeps
+                # iterated radius-1 passes — observable, not silent
+                warnings.warn(
+                    f"impl='composed' auto-k degenerated to k=1 for "
+                    f"substeps={substeps} (no divisor <= the window's "
+                    f"composable depth "
+                    f"{max_k(space.shape, space.dtype)}): each call "
+                    "runs iterated radius-1 passes, equaling the "
+                    "iterated path. Pick substeps with a small divisor "
+                    "to actually compose.", RuntimeWarning)
             composed_steppers = {
                 attr: ComposedDiffusionStep(
                     space.shape, rate, ck, dtype=space.dtype,
                     offsets=offsets, interpret=interp,
                     compute_dtype=compute_dtype)
                 for attr, rate in rates.items() if rate != 0.0}
+        active_steppers = None
+        if impl == "active":
+            # the active-tile engine (ops.active): compute only tiles
+            # whose ring-1 neighborhood holds mass — bitwise-exact
+            # skipping for uniform-rate linear flows (zero stays zero),
+            # dense fallback the same step above the capacity/activity
+            # threshold. Point flows compose (they fire after the field
+            # step; activity is recomputed from the values each call).
+            rates = self.pallas_rates()
+            if rates is None:
+                raise ValueError(
+                    "impl='active' requires all field flows to be plain "
+                    "Diffusion (the tile-skip rule is only bitwise-exact "
+                    "for uniform-rate linear flows); got "
+                    f"flows={[type(f).__name__ for f in self.flows]}. "
+                    "Use impl='xla'/'auto'.")
+            live = {a: r for a, r in rates.items() if r != 0.0}
+            if rates and not live:
+                raise ValueError(
+                    "impl='active' has nothing to step: every Diffusion "
+                    "rate is 0.0 (no field transport). Use "
+                    "impl='xla'/'auto' for a no-op field step.")
+            if not rates:
+                raise ValueError(
+                    "impl='active' needs a Diffusion field flow; "
+                    "all-point models already take the point-subsystem "
+                    "fast path (the executors route them automatically).")
+            for a in live:
+                adt = space.values[a].dtype
+                if adt != jnp.dtype(space.dtype):
+                    raise ValueError(
+                        "impl='active' computes every flow channel in "
+                        f"the space dtype ({jnp.dtype(space.dtype).name});"
+                        f" channel {a!r} is {adt}. Use impl='xla'.")
+            from ..ops.active import ActiveDiffusionStep
+            active_steppers = {
+                attr: ActiveDiffusionStep(
+                    space.shape, rate, dtype=space.dtype, offsets=offsets,
+                    origin=origin, global_shape=space.global_shape,
+                    dense_fn=self._probe_pallas_dense(space, rate,
+                                                      compute_dtype))
+                for attr, rate in live.items()}
         if impl in ("pallas", "auto"):
             rates = self.pallas_rates()
             all_pointwise = all(
@@ -492,6 +684,12 @@ class Model:
                     new[attr] = stepper(values[attr])
             elif pallas_field_stepper is not None:
                 new.update(pallas_field_stepper(values))
+            elif active_steppers is not None:
+                # one active-set pass per flow channel; zero-rate
+                # Diffusions move nothing and are skipped (the pallas/
+                # composed discipline)
+                for attr, stepper in active_steppers.items():
+                    new[attr] = stepper(values[attr])
             else:
                 outflow = build_outflow(field_flows, values, origin)
                 for attr, o in outflow.items():
@@ -519,11 +717,18 @@ class Model:
 
         # which field-flow kernel the step actually uses (after any auto
         # fallback) — callers like bench report it
-        step.impl = ("composed" if composed_steppers is not None
+        step.impl = ("active" if active_steppers is not None
+                     else "composed" if composed_steppers is not None
                      else "pallas" if (pallas_steppers is not None
                                        or pallas_field_stepper is not None)
                      else "xla")
         step.substeps = substeps
+        # auto-k visibility (ISSUE 3 satellite): the chosen composed k
+        # rides the step so executors/Reports can record it
+        step.composed_k = (next(iter(composed_steppers.values())).k
+                           if composed_steppers is not None else None)
+        step.composed_passes = (composed_passes
+                                if composed_steppers is not None else None)
         self._step_cache[key] = step
         return step
 

@@ -167,12 +167,17 @@ class ShardMapExecutor:
                  compute_dtype=None):
         if len(mesh.axis_names) not in (1, 2):
             raise ValueError("ShardMapExecutor needs a 1-D or 2-D mesh")
-        if step_impl not in ("xla", "pallas", "auto", "composed"):
+        if step_impl not in ("xla", "pallas", "auto", "composed", "active"):
             raise ValueError(f"unknown step impl {step_impl!r}")
         if halo_mode not in ("exchange", "zero"):
             raise ValueError(f"unknown halo mode {halo_mode!r}")
         if int(halo_depth) < 1:
             raise ValueError(f"halo_depth must be >= 1, got {halo_depth}")
+        if step_impl == "active" and int(halo_depth) != 1:
+            raise ValueError(
+                "step_impl='active' exchanges a one-cell ghost ring per "
+                f"step; halo_depth={halo_depth} is not supported (the "
+                "active set would need depth-d frontier dilation)")
         self.mesh = mesh
         self.step_impl = step_impl
         #: DIAGNOSTIC knob for measuring halo cost (benchmarks/ladder.py's
@@ -198,6 +203,9 @@ class ShardMapExecutor:
         #: kernel the last ``run_model`` actually used ("pallas"/"xla"),
         #: after any "auto" fallback — reported by the CLI/bench
         self.last_impl: Optional[str] = None
+        #: per-run report detail (Report.backend_report); None until a
+        #: run records one
+        self.last_backend_report: Optional[dict] = None
         self._cache: dict = {}
 
     @property
@@ -275,6 +283,9 @@ class ShardMapExecutor:
 
     def run_model(self, model, space: CellularSpace, num_steps: int) -> Values:
         _check_divisible(space, self.mesh)
+        #: per-run report detail (Report.backend_report) — reset so a
+        #: previous run's composed record never leaks forward
+        self.last_backend_report = None
         # origin is part of the identity: the compiled runners bake
         # row0/col0 and the boundary mask from it, so two same-shaped
         # partitions at different origins must not share a runner. The
@@ -297,7 +308,8 @@ class ShardMapExecutor:
         # step only the ≤9k involved cells per shard — constant per-step
         # deltas mean NO halo traffic at all; owned entries scatter back
         # once per run. Bitwise equal to the halo path.
-        if (self.halo_depth == 1 and self.step_impl in ("xla", "auto")
+        if (self.halo_depth == 1
+                and self.step_impl in ("xla", "auto", "active")
                 and model.flows
                 and all(isinstance(f, PointFlow) for f in model.flows)):
             mkey = ("pointmini",) + key
@@ -322,6 +334,43 @@ class ShardMapExecutor:
                 self.last_impl = "point"
                 return runner(values, n)
 
+        # shard-local active sets (ISSUE 3): each shard tracks its OWN
+        # tile activity — the one-cell ppermute ghost ring both feeds
+        # the tile windows and activates edge tiles (ghost_flags), so
+        # cross-shard frontier arrival is seen one step early, exactly
+        # like the interior dilation. The per-shard dense fallback
+        # consumes the same exchanged ring (the exchange sits OUTSIDE
+        # the cond: collectives must run on every shard every step).
+        if self.step_impl == "active":
+            akey = ("active", key)
+            entry = self._cache.get(akey)
+            if entry is None:
+                with get_tracer().span("shardmap.build", impl="active"):
+                    entry = self._build_active_runner(model, space)
+                self._cache[akey] = entry
+            runner, plan, nattr, nshards = entry
+            out, (fb, at) = runner(values, n)
+            self.last_impl = "active"
+            ntiles = plan.ntiles * nshards
+            self.last_backend_report = {
+                "impl": "active",
+                "steps": int(num_steps),
+                "shards": nshards,
+                #: (shard, attr, step) triples that ran the per-shard
+                #: dense fallback — psum'd, so an all-shards-dense run
+                #: reads steps*nattr*nshards, not a silent "active"
+                "fallback_steps": int(fb),
+                "tile": list(plan.tile),
+                "tiles": ntiles,
+                "tiles_per_shard": plan.ntiles,
+                "capacity": plan.capacity,
+                "fallback_tiles": plan.fallback_tiles,
+                "mean_active_fraction": (
+                    float(at) / (num_steps * nattr * ntiles)
+                    if num_steps and nattr else None),
+            }
+            return out
+
         # one probe/build/cache protocol for both depths: the fused
         # Pallas kernel is tried first (deep halos compose with it — a
         # depth-d ring feeds d fused steps per exchange: one collective
@@ -338,6 +387,7 @@ class ShardMapExecutor:
             if prunner is not None:
                 self._cache[key] = (kind, prunner)
                 self.last_impl = kind
+                self._record_backend_report(kind, num_steps)
                 return out
             with get_tracer().span("shardmap.build",
                                    impl="deep-halo" if deep else "xla",
@@ -351,7 +401,24 @@ class ShardMapExecutor:
         #: fallback) — the CLI/bench report it so a user never believes
         #: they measured a configuration that never ran
         self.last_impl = kind
+        self._record_backend_report(kind, num_steps)
         return runner(values, n)
+
+    def _record_backend_report(self, kind: str, num_steps: int) -> None:
+        """Composed auto-k visibility (ISSUE 3 satellite): the sharded
+        composed path's k IS ``halo_depth`` and the remainder chunk
+        (``num_steps % k``) composes at its own depth — both recorded
+        in ``Report.backend_report`` so a depth that buys no
+        composition is observable."""
+        if kind != "composed":
+            return
+        d = self.halo_depth
+        self.last_backend_report = {
+            "impl": "composed",
+            "composed_k": d,
+            "full_chunks": num_steps // d,
+            "remainder_chunk_depth": num_steps % d,
+        }
 
     def _probe_pallas(self, model, space, num_steps, values, *, label,
                       fallback_name):
@@ -726,6 +793,157 @@ class ShardMapExecutor:
         sharded = shard_map(shard_fn, mesh=mesh, in_specs=(spec, P()),
                                 out_specs=spec, check_vma=False)
         return jax.jit(sharded)
+
+    def _build_active_runner(self, model, space: CellularSpace):
+        """Shard-local active-tile stepping (``ops.active``): per shard,
+        per step — one ppermute value exchange (the ghost ring), tile
+        activity = ring-1 dilation of the shard's nonzero-tile map OR'd
+        with ghost-strip activations, then either the compacted
+        active-set pass (windows read the padded shard, counts from
+        GLOBAL coordinates) or, above the capacity/activity threshold,
+        the per-shard dense step consuming the same ring. Exchanging
+        VALUES instead of shares keeps the result bitwise equal to the
+        share-exchanging XLA shard step: a ghost cell's share is
+        recomputed here from the same operands with the same expression
+        the owning shard uses.
+
+        Returns ``(runner, plan, nattr, nshards)``; the runner yields
+        ``(values, (fallback_events, active_tiles_total))`` with both
+        counters psum'd across shards (one cheap collective per run),
+        mirroring the serial runner's stats so a sharded run that
+        dense-fell-back every step is visible in
+        ``Report.backend_report``, not silently labeled "active"."""
+        from jax import lax
+
+        from ..ops import active as act
+        from ..ops.stencil import neighbor_counts_traced
+
+        rates = model.pallas_rates()
+        live = {a: r for a, r in (rates or {}).items() if r != 0.0}
+        has_point = any(isinstance(f, PointFlow) for f in model.flows)
+        if rates is None or not live or has_point:
+            raise ValueError(
+                "step_impl='active' requires all field flows to be plain "
+                "Diffusion with a nonzero rate and no point flows (the "
+                "tile-skip rule is only bitwise-exact for uniform-rate "
+                "linear flows); got "
+                f"flows={[type(f).__name__ for f in model.flows]}. "
+                "Use step_impl='xla' or 'auto'.")
+        for a in live:
+            adt = space.values[a].dtype
+            if not jnp.issubdtype(adt, jnp.floating):
+                raise TypeError(
+                    f"flow transport requires a floating dtype, got "
+                    f"{adt} for channel {a!r}")
+            if adt != jnp.dtype(space.dtype):
+                raise ValueError(
+                    "step_impl='active' computes every flow channel in "
+                    f"the space dtype ({jnp.dtype(space.dtype).name}); "
+                    f"channel {a!r} is {adt}. Use step_impl='xla'.")
+        mesh = self.mesh
+        names, nx, ny, local_h, local_w = self._shard_geometry(space)
+        plan = act.plan_for((local_h, local_w))
+        th, tw = plan.tile
+        offsets = model.offsets
+        gshape = space.global_shape
+        x_init, y_init = space.x_init, space.y_init
+        dtype = space.dtype
+        spec = grid_spec(mesh)
+
+        if self.halo_mode == "zero":
+            def pad(z):  # diagnostic: no inter-shard traffic
+                return jnp.pad(z, 1)
+        elif len(names) == 1:
+            def pad(z):
+                return pad_with_halo_1d(z, names[0], nx)
+        else:
+            def pad(z):
+                return pad_with_halo_2d(z, names[0], names[1], nx, ny)
+
+        def shard_fn(values, n):
+            row0 = np.int32(x_init) + lax.axis_index(names[0]) * np.int32(
+                local_h)
+            col0 = (np.int32(y_init)
+                    + lax.axis_index(names[1]) * np.int32(local_w)
+                    if len(names) > 1 else jnp.int32(y_init))
+            # true neighbor counts over the PADDED shard from global
+            # coords (hoisted per compile); off-grid ghosts clamp to 1 —
+            # their value is ppermute's zero fill anyway
+            counts_pad = jnp.maximum(
+                neighbor_counts_traced(
+                    (local_h + 2, local_w + 2), offsets,
+                    (row0 - np.int32(1), col0 - np.int32(1)), gshape,
+                    dtype),
+                jnp.asarray(1, dtype))
+
+            def step_attr(vals_a, tmap, upd, rate):
+                # per-step cond here (unlike the serial runner's
+                # while-nest): the ghost exchange is a collective that
+                # must run on every shard every step, so consecutive
+                # active steps cannot be batched past it — the cond's
+                # buffer-copy tax is paid on the (smaller) per-shard
+                # arrays and accepted. The tile map is CARRIED, not
+                # rebuilt from the shard values (the serial runner's
+                # measured lesson: a full-array nonzero reduction per
+                # step costs a third of the step); the active branch
+                # derives the exact next map from its own per-lane
+                # flags, the dense branch re-scans only on fallback
+                # EVENTS.
+                padded = pad(vals_a)  # collective — OUTSIDE the cond
+                flags = (act.dilate_tile_map(tmap)
+                         | act.ghost_flags(padded, plan))
+                count = jnp.sum(flags, dtype=jnp.int32)
+                pred = count > np.int32(plan.fallback_tiles)
+
+                def dense_branch(args):
+                    p, u = args
+                    new = act.dense_from_ghost_padded(
+                        p, rate, counts_pad, offsets, dtype)
+                    return new, act.tile_nonzero_map(new, plan), u
+
+                def active_branch(args):
+                    p, u = args
+                    ids, cnt = act.compact_tile_ids(flags, plan)
+                    p2, u2, anyf = act.active_pass(
+                        p, u, ids, cnt, rate, plan, (row0, col0), gshape,
+                        offsets, dtype)
+                    return (p2[1:-1, 1:-1],
+                            act.next_tile_map(anyf, ids, cnt, plan), u2)
+
+                nv, ntm, nu = lax.cond(pred, dense_branch, active_branch,
+                                       (padded, upd))
+                return nv, ntm, nu, pred, count
+
+            upd0 = {a: jnp.zeros((plan.capacity, th, tw), dtype)
+                    for a in live}
+            # one full-shard nonzero scan per RUN seeds the carried maps
+            tmap0 = {a: act.tile_nonzero_map(values[a], plan)
+                     for a in live}
+
+            def body(i, carry):
+                vals, tmaps, upds, fb, at = carry
+                new_v, new_t, new_u = dict(vals), dict(tmaps), dict(upds)
+                for a, r in live.items():
+                    (new_v[a], new_t[a], new_u[a], p, c) = step_attr(
+                        vals[a], tmaps[a], upds[a], r)
+                    # serial-runner stats semantics (ops.active): fb
+                    # counts dense-fallback EVENTS, at sums the dilated
+                    # active-tile counts — here per (shard, attr, step)
+                    fb = fb + p.astype(jnp.int32)
+                    at = at + c.astype(jnp.float32)
+                return new_v, new_t, new_u, fb, at
+
+            # n is a TRACED scalar: one compile serves every step count
+            out, _, _, fb, at = lax.fori_loop(
+                0, n, body, (values, tmap0, upd0, jnp.int32(0),
+                             jnp.float32(0)))
+            # one collective for both counters (psum over the pair)
+            fb, at = lax.psum((fb, at), names)
+            return out, (fb, at)
+
+        sharded = shard_map(shard_fn, mesh=mesh, in_specs=(spec, P()),
+                            out_specs=(spec, (P(), P())))
+        return jax.jit(sharded), plan, len(live), nx * ny
 
     def _build_runner(self, model, space: CellularSpace):
         mesh = self.mesh

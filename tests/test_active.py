@@ -1,0 +1,475 @@
+"""Active-tile stepping engine (ISSUE 3): the skip rule must be
+BITWISE-exact vs the dense path — zero tiles stay zero, frontier tiles
+activate one step before flux arrives — and the capacity/activity
+fallback must engage (and match) rather than ever truncate.
+
+Comparisons run through jitted programs (executors jit everything): a
+compiled graph is the unit the bitwise contract is defined over —
+eager op-by-op dispatch compiles each op separately, which changes
+LLVM's FMA-contraction choices and is not an execution path any
+executor takes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mpi_model_tpu as mm
+from mpi_model_tpu.models.model import SerialExecutor
+from mpi_model_tpu.ops.active import (
+    ActiveDiffusionStep,
+    compact_tile_ids,
+    dilate_tile_map,
+    ghost_flags,
+    plan_for,
+    tile_nonzero_map,
+)
+
+
+def point_space(g, dtype, sources=((64, 64, 1.7),)):
+    v = np.zeros((g, g), np.float64)
+    for x, y, a in sources:
+        v[x, y] = a
+    return mm.CellularSpace.create(g, g, 0.0, dtype=dtype).with_values(
+        {"value": jnp.asarray(v, dtype)})
+
+
+def run_exact(model, space, steps, ex_a, ex_x=None):
+    """(active output, dense output, active Report) for the same run."""
+    ex_x = ex_x or SerialExecutor(step_impl="xla")
+    out_a, rep_a = model.execute(space, ex_a, steps=steps,
+                                 check_conservation=False)
+    out_x, _ = model.execute(space, ex_x, steps=steps,
+                             check_conservation=False)
+    return out_a, out_x, rep_a
+
+
+# -- plan / map primitives ---------------------------------------------------
+
+def test_plan_defaults_and_validation():
+    p = plan_for((256, 256))
+    assert p.tile == (128, 128) and p.grid == (2, 2) and p.ntiles == 4
+    assert p.capacity == 1 and p.fallback_tiles == 1  # ceil(0.25 * 4)
+    p2 = plan_for((96, 64), tile=(16, 16), capacity=10)
+    assert p2.grid == (6, 4) and p2.capacity == 10
+    with pytest.raises(ValueError, match="does not tile"):
+        plan_for((100, 100), tile=(16, 16))
+    with pytest.raises(ValueError, match="max_active_frac"):
+        plan_for((64, 64), max_active_frac=0.0)
+    with pytest.raises(ValueError, match="capacity"):
+        plan_for((64, 64), capacity=0)
+
+
+def test_tile_maps_and_compaction():
+    plan = plan_for((64, 64), tile=(16, 16), capacity=16)
+    v = jnp.zeros((64, 64)).at[17, 2].set(3.0)  # tile (1, 0)
+    tmap = np.asarray(tile_nonzero_map(v, plan))
+    assert tmap.sum() == 1 and tmap[1, 0]
+    dil = np.asarray(dilate_tile_map(jnp.asarray(tmap)))
+    # ring-1 dilation clipped at the tile-grid edge: 2x3 block
+    assert dil.sum() == 6 and dil[0:3, 0:2].sum() == 6
+    ids, count = compact_tile_ids(jnp.asarray(dil), plan)
+    assert int(count) == 6
+    got = sorted(int(i) for i in np.asarray(ids)[:6])
+    assert got == [0, 1, 4, 5, 8, 9]  # row-major tile indices
+
+
+def test_ghost_flags_activate_edge_tiles():
+    plan = plan_for((32, 32), tile=(16, 16))
+    padded = jnp.zeros((34, 34))
+    assert not np.asarray(ghost_flags(padded, plan)).any()
+    # a north-ghost cell one column past the tile seam must activate
+    # BOTH edge tiles whose windows contain it (the strip dilation)
+    padded = padded.at[0, 17].set(1.0)  # local col 16: first col, tile 1
+    f = np.asarray(ghost_flags(padded, plan))
+    assert f[0, 1] and f[0, 0] and f.sum() == 2
+    # corner ghost activates only the corner tile
+    f2 = np.asarray(ghost_flags(jnp.zeros((34, 34)).at[33, 33].set(2.0),
+                                plan))
+    assert f2[1, 1] and f2.sum() == 1
+
+
+# -- bitwise parity: the amortized serial runner -----------------------------
+
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.float32])
+def test_runner_bitwise_point_source(dtype):
+    # wavefront crosses several tile boundaries over 30 steps; the
+    # active runner must reproduce the dense XLA path BITWISE
+    space = point_space(128, dtype, sources=((64, 64, 1.7), (10, 13, 2.2)))
+    model = mm.Model(mm.Diffusion(0.1), 1.0, 1.0)
+    ex = SerialExecutor(step_impl="active",
+                        active_opts={"tile": (8, 8),
+                                     "max_active_frac": 0.9})
+    out_a, out_x, rep = run_exact(model, space, 30, ex)
+    assert np.array_equal(np.asarray(out_a.values["value"]),
+                          np.asarray(out_x.values["value"]))
+    br = rep.backend_report
+    assert ex.last_impl == "active" and br["impl"] == "active"
+    assert br["fallback_steps"] == 0  # the active engine actually ran
+    assert 0.0 < br["mean_active_fraction"] < 1.0
+
+
+def test_runner_quiet_ocean_stays_exactly_zero():
+    space = point_space(96, jnp.float64, sources=((48, 48, 1.0),))
+    model = mm.Model(mm.Diffusion(0.2), 1.0, 1.0)
+    ex = SerialExecutor(step_impl="active", active_opts={"tile": (16, 16)})
+    out, _ = model.execute(space, ex, steps=3, check_conservation=False)
+    v = np.asarray(out.values["value"])
+    # after 3 steps the front reaches distance 3; everything beyond the
+    # frontier tiles' reach is EXACTLY zero (never touched, not 1e-30)
+    assert (v[:40, :40] == 0.0).all() and (v[60:, :30] == 0.0).all()
+    assert v[48, 48] != 0.0
+
+
+def test_runner_multi_channel_rates():
+    rng = np.random.default_rng(5)
+    blob = rng.uniform(0.5, 2.0, (8, 8))
+    va = np.zeros((64, 64), np.float64)
+    vb = np.zeros((64, 64), np.float64)
+    va[8:16, 8:16] = blob
+    vb[40:48, 40:48] = blob * 2
+    space = mm.CellularSpace.create(
+        64, 64, {"a": 0.0, "b": 0.0}, dtype=jnp.float64).with_values(
+        {"a": jnp.asarray(va), "b": jnp.asarray(vb)})
+    model = mm.Model([mm.Diffusion(0.1, attr="a"),
+                      mm.Diffusion(0.3, attr="b")], 1.0, 1.0)
+    ex = SerialExecutor(step_impl="active",
+                        active_opts={"tile": (8, 8),
+                                     "max_active_frac": 0.9})
+    out_a, out_x, rep = run_exact(model, space, 10, ex)
+    for k in ("a", "b"):
+        assert np.array_equal(np.asarray(out_a.values[k]),
+                              np.asarray(out_x.values[k])), k
+    assert rep.backend_report["fallback_steps"] == 0
+
+
+# -- fallback contract -------------------------------------------------------
+
+def test_capacity_overflow_falls_back_and_matches():
+    space = point_space(128, jnp.float64)
+    model = mm.Model(mm.Diffusion(0.1), 1.0, 1.0)
+    ex = SerialExecutor(step_impl="active",
+                        active_opts={"tile": (8, 8), "capacity": 2})
+    out_a, out_x, rep = run_exact(model, space, 10, ex)
+    br = rep.backend_report
+    assert br["fallback_steps"] == 10  # engaged every step (9 tiles > 2)
+    assert np.array_equal(np.asarray(out_a.values["value"]),
+                          np.asarray(out_x.values["value"]))
+
+
+def test_activity_threshold_falls_back_and_matches():
+    # a fully-lit grid is above any fractional threshold: dense every step
+    space = mm.CellularSpace.create(64, 64, 1.0, dtype=jnp.float64)
+    model = mm.Model(mm.Diffusion(0.1), 1.0, 1.0)
+    ex = SerialExecutor(step_impl="active",
+                        active_opts={"tile": (8, 8),
+                                     "max_active_frac": 0.25})
+    out_a, out_x, rep = run_exact(model, space, 5, ex)
+    assert rep.backend_report["fallback_steps"] == 5
+    assert np.array_equal(np.asarray(out_a.values["value"]),
+                          np.asarray(out_x.values["value"]))
+
+
+def test_fallback_recovers_to_active_when_capacity_allows():
+    # generous threshold: the run starts active and STAYS active even
+    # as the front grows — fallback count must remain 0 while the
+    # measured activity grows monotonically
+    space = point_space(128, jnp.float64)
+    model = mm.Model(mm.Diffusion(0.1), 1.0, 1.0)
+    ex = SerialExecutor(step_impl="active",
+                        active_opts={"tile": (8, 8),
+                                     "max_active_frac": 1.0})
+    _, rep5 = model.execute(space, ex, steps=5, check_conservation=False)
+    _, rep25 = model.execute(space, ex, steps=25, check_conservation=False)
+    assert rep5.backend_report["fallback_steps"] == 0
+    assert rep25.backend_report["fallback_steps"] == 0
+    assert (rep25.backend_report["mean_active_fraction"]
+            > rep5.backend_report["mean_active_fraction"])
+
+
+# -- stateless make_step form ------------------------------------------------
+
+def test_make_step_active_bitwise_under_jit():
+    space = point_space(128, jnp.float64)
+    model = mm.Model(mm.Diffusion(0.1), 1.0, 1.0)
+    step_a = jax.jit(model.make_step(space, impl="active"))
+    step_x = jax.jit(model.make_step(space, impl="xla"))
+    assert model.make_step(space, impl="active").impl == "active"
+    va, vx = dict(space.values), dict(space.values)
+    for _ in range(20):
+        va, vx = step_a(va), step_x(vx)
+    assert np.array_equal(np.asarray(va["value"]), np.asarray(vx["value"]))
+
+
+def test_make_step_active_composes_with_point_flows():
+    # the reference's live shape: a frozen point source feeding a
+    # diffusing field — activity is recomputed from the values each
+    # step, so the injected mass activates its tile next step
+    space = point_space(128, jnp.float64)
+    model = mm.Model([mm.Diffusion(0.1),
+                      mm.Exponencial(mm.Cell(19, 3, mm.Attribute(99, 2.2)),
+                                     0.1)], 1.0, 1.0)
+    ex = SerialExecutor(step_impl="active")
+    out_a, out_x, _ = run_exact(model, space, 12, ex)
+    assert ex.last_impl == "active"
+    assert np.array_equal(np.asarray(out_a.values["value"]),
+                          np.asarray(out_x.values["value"]))
+    # the deposit at (19,3) actually spread
+    assert np.asarray(out_a.values["value"])[18, 3] != 0.0
+
+
+def test_make_step_active_partition_space():
+    space = point_space(128, jnp.float64)
+    part = space.slice_partition(mm.Partition(32, 0, 64, 128, rank=1))
+    model = mm.Model(mm.Diffusion(0.1), 1.0, 1.0)
+    pa = jax.jit(model.make_step(part, impl="active"))
+    px = jax.jit(model.make_step(part, impl="xla"))
+    ua, ux = dict(part.values), dict(part.values)
+    for _ in range(8):
+        ua, ux = pa(ua), px(ux)
+    assert np.array_equal(np.asarray(ua["value"]), np.asarray(ux["value"]))
+
+
+def test_make_step_active_rejects_ineligible_models():
+    space = mm.CellularSpace.create(
+        64, 64, {"a": 1.0, "b": 1.0}, dtype=jnp.float32)
+    coupled = mm.Model([mm.Diffusion(0.1, attr="a"),
+                        mm.Coupled(flow_rate=0.05, attr="a",
+                                   modulator="b")], 1.0, 1.0)
+    with pytest.raises(ValueError, match="plain\\s+Diffusion"):
+        coupled.make_step(space, impl="active")
+    zero = mm.Model(mm.Diffusion(0.0), 1.0, 1.0)
+    sp = mm.CellularSpace.create(64, 64, 1.0, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="nothing to step"):
+        zero.make_step(sp, impl="active")
+
+
+def test_all_point_models_route_to_point_subsystem():
+    space = mm.CellularSpace.create(64, 64, 1.0, dtype=jnp.float64)
+    model = mm.Model(
+        mm.Exponencial(mm.Cell(19, 3, mm.Attribute(99, 2.2)), 0.1),
+        10.0, 0.2)
+    ex = SerialExecutor(step_impl="active")
+    out, rep = model.execute(space, ex, steps=5)
+    assert ex.last_impl == "point"  # the ultimate active set: ≤9k cells
+
+
+# -- sharded: shard-local active sets ----------------------------------------
+
+@pytest.mark.parametrize("mesh_shape", [(4, 1), (2, 2)])
+def test_shardmap_active_bitwise(eight_devices, mesh_shape):
+    from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh, \
+        make_mesh_2d
+
+    lines, cols = mesh_shape
+    mesh = (make_mesh(lines, devices=eight_devices[:lines]) if cols == 1
+            else make_mesh_2d(lines, cols,
+                              devices=eight_devices[:lines * cols]))
+    # sources near shard seams: cross-shard frontier arrival rides the
+    # ghost ring and must activate the receiving shard's edge tiles
+    space = point_space(128, jnp.float64,
+                        sources=((63, 5, 1.7), (64, 64, 2.0), (0, 127, 1.1)))
+    model = mm.Model(mm.Diffusion(0.1), 1.0, 1.0)
+    ex = ShardMapExecutor(mesh, step_impl="active")
+    out = ex.run_model(model, space, 30)
+    assert ex.last_impl == "active"
+    want, _ = model.execute(space, SerialExecutor(step_impl="xla"),
+                            steps=30, check_conservation=False)
+    assert np.array_equal(np.asarray(out["value"]),
+                          np.asarray(want.values["value"]))
+    # psum'd run stats: global tile count, bounded activity fraction
+    br = ex.last_backend_report
+    assert br is not None and br["impl"] == "active"
+    assert br["shards"] == lines * cols
+    assert br["tiles"] == br["tiles_per_shard"] * br["shards"]
+    assert 0.0 < br["mean_active_fraction"] <= 1.0
+    assert 0 <= br["fallback_steps"] <= 30 * br["shards"]
+
+
+def test_shardmap_active_dense_fallback_counted(eight_devices):
+    """An all-nonzero grid exceeds every shard's activity threshold:
+    each (shard, step) must run the dense fallback — visible in the
+    psum'd ``fallback_steps``, and bitwise equal to the XLA shard step."""
+    from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh
+
+    mesh = make_mesh(4, devices=eight_devices[:4])
+    # 512² over 4 shards: each 128x512 shard plans 4 tiles with a
+    # fallback threshold of 1 — an everywhere-nonzero grid trips it
+    rng = np.random.default_rng(7)
+    v = rng.uniform(0.5, 1.5, (512, 512))
+    space = mm.CellularSpace.create(512, 512, 0.0,
+                                    dtype=jnp.float64).with_values(
+        {"value": jnp.asarray(v, jnp.float64)})
+    model = mm.Model(mm.Diffusion(0.1), 1.0, 1.0)
+    steps = 4
+    ex = ShardMapExecutor(mesh, step_impl="active")
+    out = ex.run_model(model, space, steps)
+    br = ex.last_backend_report
+    assert br["fallback_steps"] == steps * br["shards"]  # every one
+    assert br["mean_active_fraction"] == 1.0
+    ex_x = ShardMapExecutor(mesh, step_impl="xla")
+    want = ex_x.run_model(model, space, steps)
+    assert np.array_equal(np.asarray(out["value"]),
+                          np.asarray(want["value"]))
+
+
+def test_active_int_channel_raises_cleanly(eight_devices):
+    """A Diffusion on an int channel must fail with make_step's clean
+    'requires a floating dtype' TypeError on EVERY active entry point,
+    not a mid-trace lax dtype mismatch (the ensemble path already
+    checked; serial and sharded route/raise the same way)."""
+    from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh
+
+    space = mm.CellularSpace.create(64, 64, {"value": (1, "int64")})
+    model = mm.Model(mm.Diffusion(0.1), 1.0, 1.0)
+    with pytest.raises(TypeError, match="floating dtype"):
+        model.execute(space, SerialExecutor(step_impl="active"), steps=2)
+    mesh = make_mesh(4, devices=eight_devices[:4])
+    with pytest.raises(TypeError, match="floating dtype"):
+        ShardMapExecutor(mesh, step_impl="active").run_model(
+            model, space, 2)
+
+
+def test_active_mixed_float_dtype_raises_cleanly(eight_devices):
+    """The engine computes every flow channel in space.dtype (= first
+    float channel): a float flow channel with a DIFFERENT dtype must be
+    refused with a clean ValueError on every active entry point, not a
+    mid-trace lax dtype mismatch (impl='xla' handles such spaces)."""
+    from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh
+
+    space = mm.CellularSpace.create(
+        64, 64, {"aux": (1.0, "float32"), "value": (1.0, "float64")})
+    assert str(space.dtype) == "float32"  # first float channel
+    model = mm.Model(mm.Diffusion(0.1), 1.0, 1.0)  # flows on f64 "value"
+    with pytest.raises(ValueError, match="space dtype"):
+        model.execute(space, SerialExecutor(step_impl="active"), steps=2)
+    with pytest.raises(ValueError, match="space dtype"):
+        model.make_step(space, impl="active")
+    mesh = make_mesh(4, devices=eight_devices[:4])
+    with pytest.raises(ValueError, match="space dtype"):
+        ShardMapExecutor(mesh, step_impl="active").run_model(
+            model, space, 2)
+
+
+def test_shardmap_active_validation(eight_devices):
+    from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh
+
+    mesh = make_mesh(4, devices=eight_devices[:4])
+    with pytest.raises(ValueError, match="halo_depth"):
+        ShardMapExecutor(mesh, step_impl="active", halo_depth=2)
+    ex = ShardMapExecutor(mesh, step_impl="active")
+    space = mm.CellularSpace.create(
+        64, 64, {"a": 1.0, "b": 1.0}, dtype=jnp.float32)
+    model = mm.Model([mm.Diffusion(0.1, attr="a"),
+                      mm.Coupled(flow_rate=0.05, attr="a",
+                                 modulator="b")], 1.0, 1.0)
+    with pytest.raises(ValueError, match="plain Diffusion"):
+        ex.run_model(model, space, 2)
+
+
+# -- ensemble: per-scenario activity -----------------------------------------
+
+def test_ensemble_active_matches_serial_per_lane():
+    from mpi_model_tpu.ensemble import EnsembleExecutor
+
+    spaces, models = [], []
+    for i in range(3):
+        spaces.append(point_space(64, jnp.float64,
+                                  sources=((10 + 5 * i, 20, 1.0 + i),)))
+        models.append(mm.Model(mm.Diffusion(0.05 + 0.02 * i), 1.0, 1.0))
+    ex = EnsembleExecutor(impl="active")
+    outs = models[0].execute_many(spaces, models=models, executor=ex,
+                                  steps=15)
+    ser = SerialExecutor(step_impl="xla")
+    for i in range(3):
+        want, _ = models[i].execute(spaces[i], ser, steps=15,
+                                    check_conservation=False)
+        assert np.array_equal(np.asarray(outs[i][0].values["value"]),
+                              np.asarray(want.values["value"])), i
+    assert ex.last_impl == "active"
+
+
+def test_ensemble_active_reports_fallback():
+    """Dense (all-nonzero) scenarios trip every lane's activity
+    threshold each step: the stat lanes must surface that in both the
+    executor aggregate and each lane's Report — a batch that dense-fell-
+    back every step is not silently labeled "active"."""
+    from mpi_model_tpu.ensemble import EnsembleExecutor
+
+    rng = np.random.default_rng(3)
+    spaces = []
+    for _ in range(2):
+        spaces.append(mm.CellularSpace.create(
+            512, 512, 0.0, dtype=jnp.float64).with_values(
+            {"value": jnp.asarray(rng.uniform(0.5, 1.5, (512, 512)))}))
+    model = mm.Model(mm.Diffusion(0.1), 1.0, 1.0)
+    ex = EnsembleExecutor(impl="active")
+    steps = 3
+    outs = model.execute_many(spaces, executor=ex, steps=steps,
+                              check_conservation=False)
+    br = ex.last_backend_report
+    assert br["impl"] == "active" and br["lanes"] == 2
+    assert br["fallback_steps"] == steps * 2          # every (lane, step)
+    assert br["per_lane_fallback_steps"] == [steps, steps]
+    assert br["mean_active_fraction"] == 1.0
+    for sp, rep in outs:
+        assert rep.backend_report["fallback_steps"] == steps
+    # a sparse batch records zero fallbacks through the same plumbing
+    # (corner sources: 4 dilated tiles each — at the default 512² plan's
+    # 4-tile threshold, an interior source's 9 would trip it)
+    sparse = [point_space(512, jnp.float64, sources=((1, 1, 1.0),)),
+              point_space(512, jnp.float64, sources=((510, 510, 2.0),))]
+    outs2 = model.execute_many(sparse, executor=ex, steps=steps,
+                               check_conservation=False)
+    assert ex.last_backend_report["fallback_steps"] == 0
+    assert 0 < ex.last_backend_report["mean_active_fraction"] <= 0.25
+    for sp, rep in outs2:
+        assert rep.backend_report["fallback_steps"] == 0
+
+
+def test_ensemble_active_rejects_non_diffusion():
+    from mpi_model_tpu.ensemble import EnsembleExecutor
+
+    space = mm.CellularSpace.create(64, 64, 1.0, dtype=jnp.float64)
+    model = mm.Model(
+        mm.Exponencial(mm.Cell(19, 3, mm.Attribute(99, 2.2)), 0.1),
+        1.0, 1.0)
+    with pytest.raises(ValueError, match="all-Diffusion"):
+        model.execute_many([space], executor=EnsembleExecutor(impl="active"),
+                           steps=2)
+
+
+def test_ensemble_impl_validation():
+    from mpi_model_tpu.ensemble import EnsembleExecutor
+
+    with pytest.raises(ValueError, match="active"):
+        EnsembleExecutor(impl="bogus")
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_cli_impl_active(capsys):
+    import json
+
+    from mpi_model_tpu.cli import main
+
+    rc = main(["run", "--flow=diffusion", "--impl=active", "--dimx=64",
+               "--dimy=64", "--steps=3", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["conserved"] and out["impl"] == "active"
+
+
+def test_cli_ensemble_impl_active(capsys):
+    import json
+
+    from mpi_model_tpu.cli import main
+
+    rc = main(["run", "--flow=diffusion", "--ensemble=3",
+               "--ensemble-impl=active", "--dimx=64", "--dimy=64",
+               "--steps=3", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["conserved"] and out["impl"] == "active"

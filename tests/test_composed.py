@@ -319,3 +319,57 @@ def test_model_rectangular_composed_passthrough(eight_devices):
     assert ex.last_impl == "composed"
     got = np.asarray(out.values["value"], np.float64)
     np.testing.assert_allclose(got, _oracle(v0, 4), rtol=0, atol=1e-5)
+
+
+def test_composed_backend_report_records_auto_k():
+    """Auto-k visibility (ISSUE 3 satellite): the chosen k and the
+    remainder chunk's depth land in Report.backend_report — composed
+    silently equaling the iterated path must be observable."""
+    space = CellularSpace.create(128, 128, 1.0, dtype="float32")
+    model = Model(Diffusion(RATE), 1.0, 1.0)
+    ex = SerialExecutor(step_impl="composed", substeps=4)
+    out, rep = model.execute(space, ex, steps=10)
+    br = rep.backend_report
+    assert br["impl"] == "composed"
+    assert br["composed_k"] == 4 and br["substeps"] == 4
+    assert br["remainder_steps"] == 2 and br["remainder_k"] == 1
+    # a report from one run must not leak into the next executor use
+    ex2 = SerialExecutor(step_impl="xla")
+    out2, rep2 = model.execute(space, ex2, steps=2)
+    assert rep2.backend_report is None
+
+
+def test_composed_auto_k_degeneration_warns():
+    """Prime substeps beyond the window's composable depth degenerate
+    auto-k to 1 — impl='composed' then equals the iterated path, which
+    must WARN, not pass silently (ISSUE 3 satellite)."""
+    space = CellularSpace.create(128, 128, 1.0, dtype="float32")
+    model = Model(Diffusion(RATE), 1.0, 1.0)
+    # f32 cap is 8 at the default block; 11 is prime and > 8 → k=1
+    with pytest.warns(RuntimeWarning, match="auto-k degenerated"):
+        step = model.make_step(space, impl="composed", substeps=11)
+    assert step.composed_k == 1 and step.composed_passes == 11
+    # a composable substeps count must NOT warn
+    import warnings as _w
+
+    model2 = Model(Diffusion(RATE * 2), 1.0, 1.0)
+    with _w.catch_warnings():
+        _w.simplefilter("error", RuntimeWarning)
+        step2 = model2.make_step(space, impl="composed", substeps=8)
+    assert step2.composed_k == 8
+
+
+def test_shardmap_composed_backend_report(mesh1d):
+    """The sharded composed path records k (= halo_depth) and the
+    remainder chunk depth actually used."""
+    from mpi_model_tpu.parallel import ShardMapExecutor
+
+    g = 64
+    space = CellularSpace.create(g, g, 1.0, dtype="float32")
+    model = Model(Diffusion(RATE), 1.0, 1.0)
+    ex = ShardMapExecutor(mesh1d, step_impl="composed", halo_depth=2)
+    ex.run_model(model, space, 5)
+    assert ex.last_impl == "composed"
+    br = ex.last_backend_report
+    assert br["composed_k"] == 2
+    assert br["full_chunks"] == 2 and br["remainder_chunk_depth"] == 1

@@ -1,17 +1,26 @@
-"""Marker audit (ISSUE 2 satellite): the tier-1 wall — the 870 s
-``-m "not slow"`` inner-loop profile ROADMAP.md pins — stays thin only
-if every test that spawns a subprocess or runs a multihost/multichip
-dryrun is marked ``slow``. This test enforces that STRUCTURALLY over the
-test sources, so a new test (say, an ensemble CLI rig) cannot silently
-re-fatten the inner loop: it either carries the marker or fails here.
+"""Marker audit (ISSUE 2 satellite; grid check ISSUE 3): the tier-1
+wall — the 870 s ``-m "not slow"`` inner-loop profile ROADMAP.md pins —
+stays thin only if every test that spawns a subprocess, runs a
+multihost/multichip dryrun, or steps a BIG grid is marked ``slow``.
+This test enforces that STRUCTURALLY over the test sources, so a new
+test (say, an ensemble CLI rig, or an oracle check at a bench-sized
+geometry) cannot silently re-fatten the inner loop: it either carries
+the marker or fails here.
 
 Heaviness is detected from the AST: a test function is heavy when it
 (or a module-local helper it calls, transitively) references the
-``subprocess`` module / ``Popen`` / ``pexpect``, or calls anything whose
+``subprocess`` module / ``Popen`` / ``pexpect``, calls anything whose
 name contains ``dryrun`` (the multihost/multichip rigs spawn worker
-processes internally). Heavy tests must be marked slow — a
-``pytest.mark.slow`` decorator on the function/class or a module-level
-``pytestmark``."""
+processes internally), or makes a call whose literal arguments (after
+simple constant propagation through module/function-level ``name =
+INT`` assignments, tuples flattened) contain TWO OR MORE integers >=
+2048 — the grid-construction shape ``create(4096, 4096, ...)`` /
+``ones((2048, 2048))``, i.e. a >= 2048² grid (one big literal alone —
+a 1024x2048 strip, a byte count — does not trip it). Heavy tests must
+be marked slow — a ``pytest.mark.slow`` decorator on the
+function/class or a module-level ``pytestmark``. A ``--durations=15``
+audit step (recorded in the verify skill) backstops what the AST
+cannot see."""
 
 from __future__ import annotations
 
@@ -24,6 +33,9 @@ TESTS_DIR = Path(__file__).resolve().parent
 HEAVY_NAMES = {"subprocess", "Popen", "pexpect"}
 #: calling anything whose name contains one of these marks it heavy
 HEAVY_NAME_PARTS = ("dryrun",)
+#: a call carrying >= 2 literal ints >= this constructs a >= GRID²
+#: grid: ~17M+ cells per array on the CPU rig — inner-loop poison
+GRID_LIMIT = 2048
 
 
 def _marks_slow(node: ast.AST) -> bool:
@@ -32,6 +44,61 @@ def _marks_slow(node: ast.AST) -> bool:
     and marker lists)."""
     return any(isinstance(n, ast.Attribute) and n.attr == "slow"
                for n in ast.walk(node))
+
+
+def _const_env(tree: ast.AST) -> dict[str, int]:
+    """name → int for simple ``g = 4096``-style assignments anywhere in
+    the module (module or function scope) — enough constant propagation
+    to catch the idiomatic ``g = 4096; create(g, g, ...)`` shape. A
+    name assigned two different ints keeps the LARGER (conservative:
+    the audit must not under-flag)."""
+    env: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)
+                and not isinstance(node.value.value, bool)):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                env[t.id] = max(env.get(t.id, 0), node.value.value)
+    return env
+
+
+def _call_int_literals(call: ast.Call, env: dict[str, int]) -> list[int]:
+    """Integer literals carried by a call's args/keywords, tuples
+    flattened, simple names resolved through ``env``."""
+    out: list[int] = []
+
+    def visit(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            out.append(node.value)
+        elif isinstance(node, ast.Name) and node.id in env:
+            out.append(env[node.id])
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                visit(e)
+
+    for a in call.args:
+        visit(a)
+    for kw in call.keywords:
+        visit(kw.value)
+    return out
+
+
+def _builds_big_grid(fn: ast.AST, env: dict[str, int]) -> bool:
+    """True when some call in ``fn`` carries >= 2 int literals >=
+    GRID_LIMIT — the >= 2048² grid-construction shape (ISSUE 3
+    satellite: tier-1 wall headroom)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            big = [v for v in _call_int_literals(node, env)
+                   if v >= GRID_LIMIT]
+            if len(big) >= 2:
+                return True
+    return False
 
 
 def _directly_heavy(fn: ast.AST) -> bool:
@@ -78,7 +145,9 @@ def _audit_module(path: Path) -> list[str]:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             funcs.setdefault(node.name, node)
 
-    heavy = {name for name, fn in funcs.items() if _directly_heavy(fn)}
+    env = _const_env(tree)
+    heavy = {name for name, fn in funcs.items()
+             if _directly_heavy(fn) or _builds_big_grid(fn, env)}
     changed = True
     while changed:  # propagate through helper calls to a fixpoint
         changed = False
@@ -112,10 +181,11 @@ def test_subprocess_and_dryrun_tests_are_marked_slow():
             continue
         violations.extend(_audit_module(path))
     assert not violations, (
-        "these tests spawn subprocesses or run multihost/multichip "
-        "dryruns but are not marked slow — they would fatten the tier-1 "
-        "inner loop (mark them @pytest.mark.slow or set a module "
-        f"pytestmark): {violations}")
+        "these tests spawn subprocesses, run multihost/multichip "
+        "dryruns, or construct >= 2048² grids but are not marked slow — "
+        "they would fatten the tier-1 inner loop (mark them "
+        "@pytest.mark.slow or set a module pytestmark): "
+        f"{violations}")
 
 
 def test_audit_detects_an_unmarked_heavy_test(tmp_path):
@@ -138,4 +208,36 @@ def test_audit_detects_an_unmarked_heavy_test(tmp_path):
         "pytestmark = pytest.mark.slow\n\n"
         "def test_spawns():\n"
         "    subprocess.run(['true'])\n")
+    assert _audit_module(p) == []
+
+
+def test_audit_detects_an_unmarked_big_grid_test(tmp_path):
+    """The >= 2048² grid check (ISSUE 3 satellite) must catch literal,
+    tuple, keyword and name-propagated grid constructions — and must
+    NOT flag a single big literal (a strip, a byte count)."""
+    p = tmp_path / "test_fake_grid.py"
+    p.write_text(
+        "g = 4096\n\n"
+        "def _mk():\n"
+        "    return create(g, g, 1.0)\n\n"
+        "def test_literal():\n"
+        "    ones((2048, 2048))\n\n"
+        "def test_via_name():\n"
+        "    _mk()\n\n"
+        "def test_keyword():\n"
+        "    create(dimx=2048, dimy=3072)\n\n"
+        "def test_strip_ok():\n"
+        "    ones((1024, 2048))\n\n"
+        "def test_bytes_ok():\n"
+        "    limit(65536)\n")
+    vio = _audit_module(p)
+    assert vio == ["test_fake_grid.py::test_literal",
+                   "test_fake_grid.py::test_via_name",
+                   "test_fake_grid.py::test_keyword"]
+    # a slow marker silences it
+    p.write_text(
+        "import pytest\n\n"
+        "@pytest.mark.slow\n"
+        "def test_literal():\n"
+        "    ones((2048, 2048))\n")
     assert _audit_module(p) == []

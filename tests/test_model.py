@@ -97,6 +97,7 @@ def test_conservation_error_detects_real_loss():
     assert report.conservation_error() > 1e-3
 
 
+@pytest.mark.slow  # 2048² grid: the marker audit's >= 2048² rule
 def test_conservation_scale_aware_tolerance():
     # A perfectly conserving f32 run on a large grid must NOT trip the
     # contract just because f32 reduction noise exceeds the absolute 1e-3.
