@@ -809,6 +809,8 @@ def sweep_blocks(grid: int = 8192, dtype_name: str = "bfloat16") -> list:
             t = marginal_step_time(step, v0)
             results.append({"block": list(block), "step_ms": t * 1e3,
                             "cups": grid * grid / t})
+        # analysis: ignore[broad-except] — per-row honesty: a failing
+        # block shape records its error row, the sweep continues
         except Exception as e:
             results.append({"block": list(block), "error": str(e)[:120]})
     return results

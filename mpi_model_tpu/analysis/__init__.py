@@ -1,0 +1,39 @@
+"""Static analysis for the step stack (ISSUE 4): an AST lint layer and
+a jaxpr contract auditor over a shared rule registry.
+
+Quick use::
+
+    python -m mpi_model_tpu.analysis --strict        # the PR gate
+    python -m mpi_model_tpu.analysis --json          # machine-readable
+    mpi-model-analyze --strict                       # console script
+
+Library surface: ``run_astlint`` / ``lint_source`` (layer 1, pure AST,
+no jax import), ``run_jaxpr_audit`` (layer 2, abstract traces of the
+four registered step impls), ``RULES``/``Severity``/``Finding`` from
+the registry. Suppress a finding in source with
+``# analysis: ignore[rule-id] — reason``.
+"""
+
+from .registry import (RULES, Finding, Pragma, Rule,  # noqa: F401
+                       Severity, collect_pragmas, rule)
+from .astlint import (audit_test_module, iter_py_files,  # noqa: F401
+                      lint_file, lint_source, parse_module, run_astlint)
+
+__all__ = [
+    "RULES", "Finding", "Pragma", "Rule", "Severity", "collect_pragmas",
+    "rule", "audit_test_module", "iter_py_files", "lint_file",
+    "lint_source", "parse_module", "run_astlint", "run_jaxpr_audit",
+    "main",
+]
+
+
+def run_jaxpr_audit(impls=None):
+    """Layer 2 entry point (imports jax lazily — layer 1 stays
+    millisecond-fast without it)."""
+    from .jaxpr_audit import run_jaxpr_audit as _run
+    return _run(impls)
+
+
+def main(argv=None) -> int:
+    from .__main__ import main as _main
+    return _main(argv)

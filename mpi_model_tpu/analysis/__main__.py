@@ -1,0 +1,118 @@
+"""``python -m mpi_model_tpu.analysis`` — run the static-analysis
+gate over the repo.
+
+Default mode runs the AST lint and gates on ERROR-severity findings.
+``--strict`` is the PR bar (what the tier-1 test runs): WARNINGs gate
+too, and the jaxpr contract audit traces all four registered step
+impls. Exit status 0 means zero unsuppressed findings at the selected
+bar.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .registry import RULES, Severity
+from .astlint import run_astlint
+# registering the jaxpr contract rules is import-time cheap (jax itself
+# loads lazily inside the audit) and makes --rule/--list-rules see the
+# full rule table
+from .jaxpr_audit import SCOPE_JAXPR, run_jaxpr_audit  # noqa: E402
+
+#: what a bare invocation scans, relative to the repo root
+DEFAULT_ROOTS = ("mpi_model_tpu", "tests", "benchmarks", "examples",
+                 "bench.py", "__graft_entry__.py")
+
+
+def _repo_root() -> Path:
+    # the package sits at <root>/mpi_model_tpu/analysis
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mpi-model-analyze",
+        description="AST lint + jaxpr contract audit for mpi_model_tpu")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the repo)")
+    ap.add_argument("--strict", action="store_true",
+                    help="gate WARNINGs too and run the jaxpr audit "
+                    "(the PR bar)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--no-jaxpr", action="store_true",
+                    help="skip the jaxpr audit even under --strict")
+    ap.add_argument("--rule", action="append", dest="rules",
+                    metavar="RULE-ID",
+                    help="restrict the AST lint to these rule ids")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES.values():
+            print(f"{r.name:18} {r.severity!s:8} {r.scope:8} {r.doc}")
+        return 0
+
+    ast_rules = jaxpr_rules = None
+    if args.rules:
+        unknown = [r for r in args.rules if r not in RULES]
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+        ast_rules = [r for r in args.rules
+                     if RULES[r].scope != SCOPE_JAXPR]
+        jaxpr_rules = [r for r in args.rules
+                       if RULES[r].scope == SCOPE_JAXPR]
+
+    root = _repo_root()
+    if args.paths:
+        roots = [Path(p) for p in args.paths]
+        rel_to = None
+    else:
+        roots = [root / p for p in DEFAULT_ROOTS if (root / p).exists()]
+        rel_to = root
+
+    findings = []
+    if ast_rules or not args.rules:
+        findings.extend(run_astlint(roots, rules=ast_rules,
+                                    rel_to=rel_to))
+    run_audit = (jaxpr_rules
+                 or (args.strict and not args.no_jaxpr and not args.rules))
+    if run_audit:
+        audit = run_jaxpr_audit()
+        if jaxpr_rules:
+            audit = [f for f in audit if f.rule in jaxpr_rules]
+        findings.extend(audit)
+
+    gate = (lambda f: True) if args.strict else (
+        lambda f: f.severity is Severity.ERROR)
+    blocking = [f for f in findings if not f.suppressed and gate(f)]
+    advisory = [f for f in findings if not f.suppressed and not gate(f)]
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.as_json:
+        print(json.dumps({
+            "strict": args.strict,
+            "blocking": [f.to_json() for f in blocking],
+            "advisory": [f.to_json() for f in advisory],
+            "suppressed": [f.to_json() for f in suppressed],
+        }, indent=2))
+    else:
+        for f in blocking:
+            print(f.format())
+        for f in advisory:
+            print(f.format() + "  [advisory — gates under --strict]")
+        print(f"analysis: {len(blocking)} blocking, "
+              f"{len(advisory)} advisory, "
+              f"{len(suppressed)} suppressed"
+              + (" [strict]" if args.strict else ""))
+    return 1 if blocking else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

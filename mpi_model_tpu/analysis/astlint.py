@@ -1,0 +1,606 @@
+"""AST lint layer (ISSUE 4 layer 1): JAX-specific structural rules over
+the repo's Python sources.
+
+The engine parses each module once into a ``ModuleCtx`` (tree + parent
+links + pragma map) and runs every registered rule whose scope matches.
+Rules are pure AST walks — no imports of the analyzed code, so linting
+never executes repo code and runs in milliseconds.
+
+What "traced" means here
+------------------------
+Several rules only fire *inside traced scopes* — functions whose bodies
+become jaxprs rather than running per call. Statically we treat a
+function as traced when it
+
+- is decorated with a trace entry point (``jit``/``vmap``/``pmap``/
+  ``shard_map``/``remat``/``checkpoint``, bare or via ``partial``), or
+- is passed by name (or as an inline ``lambda``) to a trace entry call:
+  ``jit``/``vmap``/``pmap``/``shard_map`` or a ``lax`` combinator
+  (``scan``/``while_loop``/``fori_loop``/``cond``/``switch``/``map``), or
+- is a nested ``def`` inside a *step builder* — a function named
+  ``make_*``/``build_*``/``_build*`` (the repo's convention for
+  functions that RETURN the pure step: ``Model.make_step``'s ``single``,
+  ``ensemble.batch.make_scenario_step``'s ``single``, the executors'
+  ``_build_*`` runner bodies). The builder body itself runs eagerly at
+  build time and is NOT traced — probing compiles with
+  ``block_until_ready`` there is exactly right.
+
+This is a heuristic with an escape hatch (the pragma), not a proof; the
+jaxpr audit (layer 2) is the ground-truth check for what actually ends
+up in the traced hot path.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Iterable, Optional
+
+from .registry import (RULES, SCOPE_ALL, SCOPE_PACKAGE, SCOPE_TESTS,
+                       Finding, Severity, apply_pragmas, collect_pragmas,
+                       rule)
+
+# -- module context -----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ModuleCtx:
+    """One parsed module, shared by every rule."""
+
+    path: str
+    tree: ast.Module
+    lines: list[str]
+    pragmas: dict
+    is_test: bool
+    #: resolved absolute path components, for package-scope matching
+    #: (a bare relative path like ``ops/stencil.py`` passed from inside
+    #: the package directory must still count as package code)
+    resolved_parts: tuple
+    #: node → enclosing node, for upward walks
+    parents: dict[ast.AST, ast.AST]
+    #: FunctionDef/AsyncFunctionDef/Lambda nodes considered traced
+    traced_scopes: set[ast.AST]
+
+    def enclosing_functions(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                yield cur
+            cur = self.parents.get(cur)
+
+    def in_traced_scope(self, node: ast.AST) -> bool:
+        return any(fn in self.traced_scopes
+                   for fn in self.enclosing_functions(node))
+
+
+#: decorators / call targets that enter a trace
+TRACE_ENTRY_NAMES = {"jit", "vmap", "pmap", "shard_map", "remat",
+                     "checkpoint"}
+#: lax combinators whose function arguments are traced
+TRACE_COMBINATORS = {"scan", "while_loop", "fori_loop", "cond", "switch",
+                     "map"} | TRACE_ENTRY_NAMES
+#: step-builder naming convention: nested defs inside these are traced
+BUILDER_PREFIXES = ("make_", "build_", "_build")
+
+
+def _dotted_last(node: ast.AST) -> Optional[str]:
+    """Last identifier of a Name/Attribute chain (``jax.lax.scan`` →
+    ``scan``), else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _decorated_as_trace(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        for n in ast.walk(dec):
+            if _dotted_last(n) in TRACE_ENTRY_NAMES:
+                return True
+    return False
+
+
+def _find_traced_scopes(tree: ast.Module,
+                        parents: dict) -> set[ast.AST]:
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda))]
+    by_name: dict[str, list[ast.AST]] = {}
+    for f in funcs:
+        if not isinstance(f, ast.Lambda):
+            by_name.setdefault(f.name, []).append(f)
+
+    traced: set[ast.AST] = set()
+    for f in funcs:
+        if _decorated_as_trace(f):
+            traced.add(f)
+            continue
+        # nested def inside a step builder (but not the builder itself)
+        cur = parents.get(f)
+        while cur is not None:
+            if (isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and cur.name.startswith(BUILDER_PREFIXES)):
+                traced.add(f)
+                break
+            cur = parents.get(cur)
+
+    # functions handed to a trace-entry call by name or inline lambda
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _dotted_last(node.func) not in TRACE_COMBINATORS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                traced.add(arg)
+            elif isinstance(arg, ast.Name):
+                traced.update(by_name.get(arg.id, []))
+    return traced
+
+
+def parse_module(source: str, path: str = "<string>") -> ModuleCtx:
+    tree = ast.parse(source, filename=path)
+    parents: dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    name = Path(path).name
+    try:
+        resolved = Path(path).resolve().parts
+    except OSError:
+        resolved = Path(path).parts
+    return ModuleCtx(
+        path=path,
+        tree=tree,
+        lines=source.splitlines(),
+        pragmas=collect_pragmas(source.splitlines()),
+        is_test=name.startswith("test_") and name.endswith(".py"),
+        resolved_parts=resolved,
+        parents=parents,
+        traced_scopes=_find_traced_scopes(tree, parents),
+    )
+
+
+# -- rules --------------------------------------------------------------------
+
+@rule("broad-except", Severity.ERROR,
+      "`except Exception`/bare `except` hides tracer leaks and dtype "
+      "bugs; only pragma'd supervisor boundaries may catch broadly "
+      "(cleanup handlers ending in a bare `raise` are exempt)")
+def check_broad_except(ctx: ModuleCtx):
+    def is_broad(t) -> bool:
+        if t is None:
+            return True  # bare except:
+        if isinstance(t, ast.Tuple):
+            return any(is_broad(e) for e in t.elts)
+        return _dotted_last(t) in ("Exception", "BaseException")
+
+    def reraises(handler: ast.ExceptHandler) -> bool:
+        # `except BaseException: <cleanup>; raise` supervises nothing —
+        # it is the atomic-write/unwind idiom, and exempt
+        last = handler.body[-1]
+        return isinstance(last, ast.Raise) and last.exc is None
+
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.ExceptHandler) and is_broad(node.type)
+                and not reraises(node)):
+            yield Finding(
+                "broad-except", Severity.ERROR, ctx.path, node.lineno,
+                "broad `except` — narrow to the exceptions this boundary "
+                "actually supervises, or pragma a genuine supervisor "
+                "boundary with its reason")
+
+
+@rule("mutable-default", Severity.ERROR,
+      "mutable default arguments ([] / {} / set()) alias across calls")
+def check_mutable_default(ctx: ModuleCtx):
+    def is_mutable(d) -> bool:
+        if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+            return True
+        return (isinstance(d, ast.Call)
+                and isinstance(d.func, ast.Name)
+                and d.func.id in ("list", "dict", "set") and not d.args)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            continue
+        a = node.args
+        for d in list(a.defaults) + [d for d in a.kw_defaults if d]:
+            if is_mutable(d):
+                yield Finding(
+                    "mutable-default", Severity.ERROR, ctx.path, d.lineno,
+                    "mutable default argument — use None and construct "
+                    "inside the function")
+
+
+#: host-sync call shapes: a name/attr called as these forces device→host
+HOST_SYNC_CALLEES = {"block_until_ready", "item"}
+#: module aliases whose ``.asarray`` materializes on host (jnp.asarray
+#: stays on device and is fine)
+NUMPY_ALIASES = {"np", "numpy", "onp"}
+
+
+@rule("host-sync", Severity.ERROR,
+      "host syncs (`block_until_ready`, `np.asarray`, `.item()`) inside "
+      "a traced/step-builder function stall the device pipeline or leak "
+      "tracers at trace time")
+def check_host_sync(ctx: ModuleCtx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not ctx.in_traced_scope(node):
+            continue
+        callee = _dotted_last(node.func)
+        msg = None
+        if callee in HOST_SYNC_CALLEES:
+            msg = f"`{callee}` call inside a traced scope"
+        elif (callee == "asarray" and isinstance(node.func, ast.Attribute)
+              and isinstance(node.func.value, ast.Name)
+              and node.func.value.id in NUMPY_ALIASES):
+            msg = ("`np.asarray` inside a traced scope materializes the "
+                   "operand on host (use `jnp.asarray`)")
+        if msg:
+            yield Finding(
+                "host-sync", Severity.ERROR, ctx.path, node.lineno,
+                msg + " — this either fails on tracers or silently "
+                "serializes the hot path")
+
+
+#: jnp constructors where an un-dtyped float literal inherits the
+#: AMBIENT x64 config instead of the space dtype
+DTYPE_DRIFT_CTORS = {"array", "asarray", "full", "linspace", "arange"}
+
+
+def _has_float_literal(node: ast.AST) -> Optional[ast.Constant]:
+    """First float literal in an arg expression, not descending into
+    nested calls (their args are that call's concern)."""
+    if isinstance(node, ast.Constant):
+        return node if isinstance(node.value, float) else None
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            hit = _has_float_literal(e)
+            if hit:
+                return hit
+        return None
+    if isinstance(node, (ast.UnaryOp, ast.BinOp)):
+        for child in ast.iter_child_nodes(node):
+            hit = _has_float_literal(child)
+            if hit:
+                return hit
+    return None
+
+
+@rule("dtype-drift", Severity.WARNING,
+      "a bare float literal in a jnp constructor takes the ambient-x64 "
+      "default dtype, not the space dtype — pin `dtype=`",
+      scope=SCOPE_PACKAGE)
+def check_dtype_drift(ctx: ModuleCtx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in DTYPE_DRIFT_CTORS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "jnp"):
+            continue
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        lit = None
+        for a in list(node.args) + [kw.value for kw in node.keywords
+                                    if kw.arg != "dtype"]:
+            lit = _has_float_literal(a)
+            if lit:
+                break
+        if lit is not None:
+            yield Finding(
+                "dtype-drift", Severity.WARNING, ctx.path, node.lineno,
+                f"`jnp.{node.func.attr}` with float literal {lit.value!r} "
+                "and no dtype= — under x64 this becomes f64 and silently "
+                "promotes the expression (pin the space/operand dtype)")
+
+
+#: test-expression shapes that are STATIC even when they touch a traced
+#: parameter: structure, dtype/shape metadata, identity-vs-None
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "impl", "substeps",
+                 "keys", "values", "items"}
+#: calls whose result is static even over a traced argument. NOTE:
+#: bool() is deliberately NOT here — bool(tracer) is exactly the
+#: ConcretizationTypeError this rule exists to catch
+_STATIC_CALLS = {"isinstance", "len", "hasattr", "getattr",
+                 "issubdtype", "tuple", "sorted", "list", "set"}
+
+
+def _branch_on_traced(test: ast.AST, params: set[str]) -> Optional[str]:
+    """Name of the traced parameter the test genuinely branches on, or
+    None when every reference is structural (is-None, isinstance, len,
+    .shape/.dtype metadata, dict membership)."""
+    static_roots: set[ast.AST] = set()
+    for n in ast.walk(test):
+        if isinstance(n, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in n.ops):
+            static_roots.update(ast.walk(n))
+        elif (isinstance(n, ast.Call)
+              and _dotted_last(n.func) in _STATIC_CALLS):
+            static_roots.update(ast.walk(n))
+        elif isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            static_roots.update(ast.walk(n))
+    for n in ast.walk(test):
+        if (isinstance(n, ast.Name) and n.id in params
+                and n not in static_roots):
+            return n.id
+    return None
+
+
+@rule("traced-branch", Severity.WARNING,
+      "a Python `if`/`while` on a traced value raises "
+      "ConcretizationTypeError at trace time (or silently bakes one "
+      "branch); use lax.cond/jnp.where")
+def check_traced_branch(ctx: ModuleCtx):
+    for fn in ctx.traced_scopes:
+        if isinstance(fn, ast.Lambda):
+            continue  # lambdas cannot contain statements
+        params = {a.arg for a in (fn.args.args + fn.args.posonlyargs
+                                  + fn.args.kwonlyargs)}
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            hit = _branch_on_traced(node.test, params)
+            if hit:
+                yield Finding(
+                    "traced-branch", Severity.WARNING, ctx.path,
+                    node.lineno,
+                    f"Python branch on traced parameter `{hit}` inside a "
+                    "traced scope — use lax.cond/lax.select/jnp.where "
+                    "(or branch on static metadata only)")
+
+
+# -- heavy-test rule (the marker audit, generalized) --------------------------
+# Absorbed from tests/test_marker_audit.py (ISSUE 2/3 satellites): the
+# tier-1 870 s wall stays thin only if every test that spawns a
+# subprocess, runs a multihost/multichip dryrun, or steps a >= 2048²
+# grid is marked slow. ``tests/test_marker_audit.py`` now fronts this
+# rule and keeps its original self-tests.
+
+#: referencing any of these names marks a function heavy
+HEAVY_NAMES = {"subprocess", "Popen", "pexpect"}
+#: calling anything whose name contains one of these marks it heavy
+HEAVY_NAME_PARTS = ("dryrun",)
+#: a call carrying >= 2 literal ints >= this constructs a >= GRID²
+#: grid: ~17M+ cells per array on the CPU rig — inner-loop poison
+GRID_LIMIT = 2048
+
+
+def _marks_slow(node: ast.AST) -> bool:
+    """True when the expression contains a ``...slow`` attribute (any
+    spelling of pytest.mark.slow, including parametrized/called forms
+    and marker lists)."""
+    return any(isinstance(n, ast.Attribute) and n.attr == "slow"
+               for n in ast.walk(node))
+
+
+def _const_env(tree: ast.AST) -> dict[str, int]:
+    """name → int for simple ``g = 4096``-style assignments anywhere in
+    the module (module or function scope) — enough constant propagation
+    to catch the idiomatic ``g = 4096; create(g, g, ...)`` shape. A
+    name assigned two different ints keeps the LARGER (conservative:
+    the audit must not under-flag)."""
+    env: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)
+                and not isinstance(node.value.value, bool)):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                env[t.id] = max(env.get(t.id, 0), node.value.value)
+    return env
+
+
+def _call_int_literals(call: ast.Call, env: dict[str, int]) -> list[int]:
+    """Integer literals carried by a call's args/keywords, tuples
+    flattened, simple names resolved through ``env``."""
+    out: list[int] = []
+
+    def visit(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            out.append(node.value)
+        elif isinstance(node, ast.Name) and node.id in env:
+            out.append(env[node.id])
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                visit(e)
+
+    for a in call.args:
+        visit(a)
+    for kw in call.keywords:
+        visit(kw.value)
+    return out
+
+
+def _builds_big_grid(fn: ast.AST, env: dict[str, int]) -> bool:
+    """True when some call in ``fn`` carries >= 2 int literals >=
+    GRID_LIMIT — the >= 2048² grid-construction shape (one big literal
+    alone — a 1024x2048 strip, a byte count — does not trip it)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            big = [v for v in _call_int_literals(node, env)
+                   if v >= GRID_LIMIT]
+            if len(big) >= 2:
+                return True
+    return False
+
+
+def _directly_heavy(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name is None:
+            continue
+        if name in HEAVY_NAMES:
+            return True
+        if any(part in name for part in HEAVY_NAME_PARTS):
+            return True
+    return False
+
+
+def _called_names(fn: ast.AST) -> set[str]:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                out.add(f.attr)
+    return out
+
+
+def _unmarked_heavy_tests(ctx: ModuleCtx) -> list[ast.AST]:
+    tree = ctx.tree
+    module_slow = any(
+        isinstance(stmt, ast.Assign)
+        and any(isinstance(t, ast.Name) and t.id == "pytestmark"
+                for t in stmt.targets)
+        and _marks_slow(stmt.value)
+        for stmt in tree.body)
+    if module_slow:
+        return []
+
+    # module-local function defs (incl. methods), for one-level-deep
+    # transitive heaviness through helpers
+    funcs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, node)
+
+    env = _const_env(tree)
+    heavy = {name for name, fn in funcs.items()
+             if _directly_heavy(fn) or _builds_big_grid(fn, env)}
+    changed = True
+    while changed:  # propagate through helper calls to a fixpoint
+        changed = False
+        for name, fn in funcs.items():
+            if name in heavy:
+                continue
+            if _called_names(fn) & heavy:
+                heavy.add(name)
+                changed = True
+
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not node.name.startswith("test_"):
+            continue
+        if node.name not in heavy:
+            continue
+        if any(_marks_slow(d) for d in node.decorator_list):
+            continue
+        out.append(node)
+    return out
+
+
+@rule("heavy-test", Severity.ERROR,
+      "tests that spawn subprocesses, run dryrun rigs, or build >= "
+      "2048² grids must carry @pytest.mark.slow (tier-1 870 s wall)",
+      scope=SCOPE_TESTS)
+def check_heavy_test(ctx: ModuleCtx):
+    for node in _unmarked_heavy_tests(ctx):
+        yield Finding(
+            "heavy-test", Severity.ERROR, ctx.path, node.lineno,
+            f"`{node.name}` spawns subprocesses, runs a multihost/"
+            "multichip dryrun, or constructs a >= 2048² grid but is not "
+            "marked slow — it would fatten the tier-1 inner loop (mark "
+            "it @pytest.mark.slow or set a module pytestmark)")
+
+
+def audit_test_module(path) -> list[str]:
+    """Marker-audit compatibility surface for
+    ``tests/test_marker_audit.py``: ``["file.py::test_name", ...]`` for
+    every unmarked heavy test, in source order."""
+    p = Path(path)
+    ctx = parse_module(p.read_text(), str(p))
+    nodes = _unmarked_heavy_tests(ctx)
+    return [f"{p.name}::{n.name}"
+            for n in sorted(nodes, key=lambda n: n.lineno)]
+
+
+# -- engine entry points ------------------------------------------------------
+
+#: directories never descended into
+SKIP_DIRS = {".git", "__pycache__", ".claude", "build", "node_modules",
+             ".pytest_cache"}
+
+
+def iter_py_files(root) -> Iterable[Path]:
+    root = Path(root)
+    if root.is_file():
+        if root.suffix == ".py":
+            yield root
+        return
+    for p in sorted(root.rglob("*.py")):
+        if not any(part in SKIP_DIRS for part in p.parts):
+            yield p
+
+
+def _scope_matches(scope: str, ctx: ModuleCtx, package_name: str) -> bool:
+    if scope == SCOPE_ALL:
+        return True
+    if scope == SCOPE_TESTS:
+        return ctx.is_test
+    if scope == SCOPE_PACKAGE:
+        return (package_name in ctx.resolved_parts
+                and not ctx.is_test)
+    return False
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Optional[Iterable[str]] = None,
+                package_name: str = "mpi_model_tpu") -> list[Finding]:
+    """All findings (suppressed ones included, flagged) for one module's
+    source. ``rules`` restricts to a subset of rule ids."""
+    ctx = parse_module(source, path)
+    selected = [RULES[r] for r in rules] if rules else list(RULES.values())
+    raw: list[Finding] = []
+    for rl in selected:
+        if _scope_matches(rl.scope, ctx, package_name):
+            raw.extend(rl.check(ctx))
+    raw.sort(key=lambda f: (f.line, f.rule))
+    return apply_pragmas(raw, ctx.pragmas, ctx.lines)
+
+
+def lint_file(path, rules: Optional[Iterable[str]] = None,
+              rel_to=None) -> list[Finding]:
+    p = Path(path)
+    shown = str(p.relative_to(rel_to)) if rel_to else str(p)
+    try:
+        source = p.read_text()
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding("parse-error", Severity.ERROR, shown, 0,
+                        f"unreadable: {e}")]
+    try:
+        return lint_source(source, shown, rules)
+    except SyntaxError as e:
+        return [Finding("parse-error", Severity.ERROR, shown,
+                        e.lineno or 0, f"syntax error: {e.msg}")]
+
+
+def run_astlint(roots, rules: Optional[Iterable[str]] = None,
+                rel_to=None) -> list[Finding]:
+    """Lint every ``.py`` under each root; findings keep file order."""
+    findings: list[Finding] = []
+    for root in roots:
+        for p in iter_py_files(root):
+            findings.extend(lint_file(p, rules, rel_to=rel_to))
+    return findings

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from typing import Optional
 
@@ -450,13 +451,23 @@ def cmd_info(args) -> int:
         from .native import build_native
 
         info["native_library"] = build_native()
-    except Exception as e:  # toolchain optional
+    except (OSError, RuntimeError, subprocess.SubprocessError) as e:
+        # toolchain optional: no cmake/ninja (OSError), a failed
+        # configure/build (SubprocessError), or a loader refusal
+        # (RuntimeError) all mean "no native library here"
         info["native_library"] = f"unavailable: {e}"
     print(json.dumps(info, indent=2 if not args.json else None))
     return 0
 
 
 def main(argv: Optional[list[str]] = None) -> int:
+    # `analyze` forwards its whole tail to the analysis CLI (argparse
+    # REMAINDER cannot pass through leading --flags, so peel it here)
+    tail = sys.argv[1:] if argv is None else list(argv)
+    if tail[:1] == ["analyze"]:
+        from .analysis import main as analyze_main
+        return analyze_main(tail[1:])
+
     ap = argparse.ArgumentParser(
         prog="python -m mpi_model_tpu.cli",
         description=__doc__.split("\n\n")[0])
@@ -560,6 +571,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     info = sub.add_parser("info", help="print device/backend info")
     info.add_argument("--json", action="store_true")
     info.set_defaults(fn=cmd_info)
+
+    sub.add_parser(
+        "analyze", add_help=False,
+        help="static analysis: AST lint + jaxpr contract audit "
+        "(all flags pass through to python -m mpi_model_tpu.analysis)")
 
     args = ap.parse_args(argv)
     steps = getattr(args, "steps", None)

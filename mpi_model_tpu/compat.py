@@ -39,6 +39,19 @@ _COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or getattr(
     pltpu, "TPUCompilerParams")
 
 
+def jaxpr_type():
+    """The ``Jaxpr`` class under whichever module this jax exports it:
+    ``jax.extend.core`` (the supported home since 0.5; ``jax.core``'s
+    alias is deprecated and removed in 0.6+) with the 0.4.x
+    ``jax.core`` fallback. Used by the jaxpr contract auditor's
+    recursive eqn walk."""
+    try:
+        from jax.extend.core import Jaxpr
+    except ImportError:
+        from jax.core import Jaxpr
+    return Jaxpr
+
+
 def tpu_compiler_params(*, vmem_limit_bytes: Optional[int] = None):
     """``pltpu.CompilerParams`` under whichever name this jax spells it."""
     return _COMPILER_PARAMS(vmem_limit_bytes=vmem_limit_bytes)

@@ -207,6 +207,9 @@ class EnsembleScheduler:
                 check_conservation=self.check_conservation,
                 tolerance=self.tolerance, rtol=self.rtol, count=k,
                 on_violation="mark")
+        # analysis: ignore[broad-except] — dispatch supervisor: any
+        # whole-batch failure must fan out to the affected tickets
+        # instead of stranding them or leaking into an unrelated caller
         except Exception as e:
             # a whole-dispatch failure (e.g. pipeline ineligibility)
             # must not strand its tickets OR leak out of an unrelated

@@ -222,7 +222,10 @@ class CheckpointManager:
             def _write():
                 try:
                     staged.write()
-                except BaseException as e:  # surfaced at the next drain
+                # analysis: ignore[broad-except] — async-writer
+                # boundary: the thread must never die silently; every
+                # failure is boxed and re-raised at the next drain
+                except BaseException as e:
                     err_box.append(e)
 
             t = threading.Thread(target=_write, daemon=True,

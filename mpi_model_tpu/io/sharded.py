@@ -180,7 +180,10 @@ def save_checkpoint_sharded(path: str, space: CellularSpace, step: int = 0,
     err: Optional[BaseException] = None
     try:
         staged.write()
-    except BaseException as e:  # vote first — a bare raise strands peers
+    # analysis: ignore[broad-except] — vote boundary: a bare raise here
+    # strands peer ranks mid-commit; the failure becomes this rank's
+    # vote and every rank raises together
+    except BaseException as e:
         err = e
     vote_writes_or_raise(err, step)
     return commit_checkpoint_sharded(staged)

@@ -370,6 +370,9 @@ class Model:
                 interpret=False, compute_dtype=compute_dtype)
             jax.block_until_ready(
                 stepper(jnp.zeros(space.shape, space.dtype)))
+        # analysis: ignore[broad-except] — compile-probe boundary: a
+        # Mosaic/XLA/device fault of ANY type means "no fused kernel
+        # here"; the probe exists to absorb it and fall back
         except Exception as e:
             warnings.warn(
                 f"Pallas dense fallback failed ({e!r}); the active "
@@ -650,6 +653,9 @@ class Model:
                         zeros = {a: jnp.zeros(space.shape, space.dtype)
                                  for a in space.values}
                         jax.block_until_ready(pallas_field_stepper(zeros))
+                # analysis: ignore[broad-except] — compile-probe
+                # boundary: impl='auto' must degrade to XLA on any
+                # trace/lowering/compile/device fault, whatever its type
                 except Exception as e:
                     warnings.warn(
                         f"Pallas step failed ({e!r}); impl='auto' falling "

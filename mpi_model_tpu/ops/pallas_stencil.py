@@ -883,8 +883,11 @@ def resolve_interpret(values=None) -> bool:
             devs = values.devices()
             if devs:
                 return all(d.platform == "cpu" for d in devs)
-        except Exception:
-            pass  # tracers/abstract values carry no device
+        except (AttributeError, TypeError):
+            # tracers/abstract values carry no device: Tracer attribute
+            # probes raise AttributeError, concretization refusals are
+            # TypeError subclasses — fall through to ambient config
+            pass
     dev = jax.config.jax_default_device
     if dev is not None:
         plat = dev if isinstance(dev, str) else getattr(dev, "platform", None)

@@ -448,6 +448,10 @@ class ShardMapExecutor:
             with tracer.span("shardmap.compile+first_run", impl=label):
                 out = jax.block_until_ready(
                     prunner(values, jnp.int32(num_steps)))
+        # analysis: ignore[broad-except] — compile-probe boundary: the
+        # sharded pallas/composed build+first-run may fail with any
+        # Mosaic/XLA/device error; explicit impls re-raise, auto falls
+        # back to the XLA shard step
         except Exception as e:
             if self.step_impl in ("pallas", "composed"):
                 raise

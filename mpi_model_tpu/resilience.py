@@ -254,6 +254,9 @@ def supervised_run(
             # succeeded.
             try:
                 getattr(manager, "flush", lambda: None)()
+            # analysis: ignore[broad-except] — unwind boundary: a flush
+            # failure must not mask the run's own in-flight exception
+            # (it re-raises only when the run succeeded)
             except BaseException:
                 if not run_raising:
                     raise
@@ -280,6 +283,9 @@ def _supervise_loop(model, space, manager, total, every, max_failures,
                     problems = check_health(out_space, initial, threshold)
                     if problems:
                         raise HealthError(problems)
+        # analysis: ignore[broad-except] — THE supervisor boundary: any
+        # step/health failure becomes a FailureEvent + rollback; only
+        # max_failures exhaustion re-raises
         except Exception as exc:  # noqa: BLE001 — supervisor boundary
             consecutive += 1
             ev = FailureEvent(

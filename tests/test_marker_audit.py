@@ -7,171 +7,32 @@ test (say, an ensemble CLI rig, or an oracle check at a bench-sized
 geometry) cannot silently re-fatten the inner loop: it either carries
 the marker or fails here.
 
-Heaviness is detected from the AST: a test function is heavy when it
-(or a module-local helper it calls, transitively) references the
-``subprocess`` module / ``Popen`` / ``pexpect``, calls anything whose
-name contains ``dryrun`` (the multihost/multichip rigs spawn worker
-processes internally), or makes a call whose literal arguments (after
-simple constant propagation through module/function-level ``name =
-INT`` assignments, tuples flattened) contain TWO OR MORE integers >=
-2048 — the grid-construction shape ``create(4096, 4096, ...)`` /
-``ones((2048, 2048))``, i.e. a >= 2048² grid (one big literal alone —
-a 1024x2048 strip, a byte count — does not trip it). Heavy tests must
-be marked slow — a ``pytest.mark.slow`` decorator on the
-function/class or a module-level ``pytestmark``. A ``--durations=15``
-audit step (recorded in the verify skill) backstops what the AST
-cannot see."""
+The detection machinery lives in the shared static-analysis engine
+(ISSUE 4): ``mpi_model_tpu.analysis.astlint`` registers it as the
+``heavy-test`` rule, so ``python -m mpi_model_tpu.analysis --strict``
+and this test enforce the SAME contract from the same code. Heaviness
+is detected from the AST exactly as before the migration: a test
+function is heavy when it (or a module-local helper it calls,
+transitively) references the ``subprocess`` module / ``Popen`` /
+``pexpect``, calls anything whose name contains ``dryrun`` (the
+multihost/multichip rigs spawn worker processes internally), or makes a
+call whose literal arguments (after simple constant propagation through
+module/function-level ``name = INT`` assignments, tuples flattened)
+contain TWO OR MORE integers >= 2048 — the grid-construction shape
+``create(4096, 4096, ...)`` / ``ones((2048, 2048))``, i.e. a >= 2048²
+grid (one big literal alone — a 1024x2048 strip, a byte count — does
+not trip it). Heavy tests must be marked slow — a ``pytest.mark.slow``
+decorator on the function/class or a module-level ``pytestmark``. A
+``--durations=15`` audit step (recorded in the verify skill) backstops
+what the AST cannot see."""
 
 from __future__ import annotations
 
-import ast
 from pathlib import Path
 
+from mpi_model_tpu.analysis import audit_test_module as _audit_module
+
 TESTS_DIR = Path(__file__).resolve().parent
-
-#: referencing any of these names marks a function heavy
-HEAVY_NAMES = {"subprocess", "Popen", "pexpect"}
-#: calling anything whose name contains one of these marks it heavy
-HEAVY_NAME_PARTS = ("dryrun",)
-#: a call carrying >= 2 literal ints >= this constructs a >= GRID²
-#: grid: ~17M+ cells per array on the CPU rig — inner-loop poison
-GRID_LIMIT = 2048
-
-
-def _marks_slow(node: ast.AST) -> bool:
-    """True when the expression contains a ``...slow`` attribute (any
-    spelling of pytest.mark.slow, including parametrized/called forms
-    and marker lists)."""
-    return any(isinstance(n, ast.Attribute) and n.attr == "slow"
-               for n in ast.walk(node))
-
-
-def _const_env(tree: ast.AST) -> dict[str, int]:
-    """name → int for simple ``g = 4096``-style assignments anywhere in
-    the module (module or function scope) — enough constant propagation
-    to catch the idiomatic ``g = 4096; create(g, g, ...)`` shape. A
-    name assigned two different ints keeps the LARGER (conservative:
-    the audit must not under-flag)."""
-    env: dict[str, int] = {}
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
-            continue
-        if not (isinstance(node.value, ast.Constant)
-                and isinstance(node.value.value, int)
-                and not isinstance(node.value.value, bool)):
-            continue
-        for t in node.targets:
-            if isinstance(t, ast.Name):
-                env[t.id] = max(env.get(t.id, 0), node.value.value)
-    return env
-
-
-def _call_int_literals(call: ast.Call, env: dict[str, int]) -> list[int]:
-    """Integer literals carried by a call's args/keywords, tuples
-    flattened, simple names resolved through ``env``."""
-    out: list[int] = []
-
-    def visit(node):
-        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
-                and not isinstance(node.value, bool):
-            out.append(node.value)
-        elif isinstance(node, ast.Name) and node.id in env:
-            out.append(env[node.id])
-        elif isinstance(node, (ast.Tuple, ast.List)):
-            for e in node.elts:
-                visit(e)
-
-    for a in call.args:
-        visit(a)
-    for kw in call.keywords:
-        visit(kw.value)
-    return out
-
-
-def _builds_big_grid(fn: ast.AST, env: dict[str, int]) -> bool:
-    """True when some call in ``fn`` carries >= 2 int literals >=
-    GRID_LIMIT — the >= 2048² grid-construction shape (ISSUE 3
-    satellite: tier-1 wall headroom)."""
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call):
-            big = [v for v in _call_int_literals(node, env)
-                   if v >= GRID_LIMIT]
-            if len(big) >= 2:
-                return True
-    return False
-
-
-def _directly_heavy(fn: ast.AST) -> bool:
-    for node in ast.walk(fn):
-        name = None
-        if isinstance(node, ast.Name):
-            name = node.id
-        elif isinstance(node, ast.Attribute):
-            name = node.attr
-        if name is None:
-            continue
-        if name in HEAVY_NAMES:
-            return True
-        if any(part in name for part in HEAVY_NAME_PARTS):
-            return True
-    return False
-
-
-def _called_names(fn: ast.AST) -> set[str]:
-    out = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call):
-            f = node.func
-            if isinstance(f, ast.Name):
-                out.add(f.id)
-            elif isinstance(f, ast.Attribute):
-                out.add(f.attr)
-    return out
-
-
-def _audit_module(path: Path) -> list[str]:
-    tree = ast.parse(path.read_text(), filename=str(path))
-    module_slow = any(
-        isinstance(stmt, ast.Assign)
-        and any(isinstance(t, ast.Name) and t.id == "pytestmark"
-                for t in stmt.targets)
-        and _marks_slow(stmt.value)
-        for stmt in tree.body)
-
-    # module-local function defs (incl. methods), for one-level-deep
-    # transitive heaviness through helpers
-    funcs: dict[str, ast.AST] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            funcs.setdefault(node.name, node)
-
-    env = _const_env(tree)
-    heavy = {name for name, fn in funcs.items()
-             if _directly_heavy(fn) or _builds_big_grid(fn, env)}
-    changed = True
-    while changed:  # propagate through helper calls to a fixpoint
-        changed = False
-        for name, fn in funcs.items():
-            if name in heavy:
-                continue
-            if _called_names(fn) & heavy:
-                heavy.add(name)
-                changed = True
-
-    violations = []
-    if module_slow:
-        return violations
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if not node.name.startswith("test_"):
-            continue
-        if node.name not in heavy:
-            continue
-        if any(_marks_slow(d) for d in node.decorator_list):
-            continue
-        violations.append(f"{path.name}::{node.name}")
-    return violations
 
 
 def test_subprocess_and_dryrun_tests_are_marked_slow():

@@ -27,7 +27,9 @@ native = pytest.importorskip("mpi_model_tpu.native")
 def lib():
     try:
         return native.load_library()
-    except Exception as e:  # toolchain missing → skip module
+    except (OSError, RuntimeError, subprocess.SubprocessError) as e:
+        # toolchain missing → skip module (no cmake/ninja, failed
+        # build, or a loader refusal)
         pytest.skip(f"native build unavailable: {e}")
 
 
