@@ -415,8 +415,12 @@ def bench_ensemble(grid: int = 4096, B: int = 8, steps: int = 8,
         models.append(Model(Diffusion(rate), 1.0, 1.0))
     template = models[0]
 
+    # retry="solo" = supervision active: a clean run reports zeros, but
+    # the row always says what the self-healing layer did (the
+    # fallback_steps per-row-honesty discipline, ISSUE 5 satellite)
     svc = EnsembleService(template, steps=steps, impl=impl,
-                          substeps=substeps, buckets=buckets_for(B))
+                          substeps=substeps, buckets=buckets_for(B),
+                          retry="solo")
     # correctness gate on the batch's edge lanes (first/last): the
     # batched engine vs a per-scenario serial run, before any timing.
     # The gate runs on its OWN executor — sharing the timed service's
@@ -491,6 +495,15 @@ def bench_ensemble(grid: int = 4096, B: int = 8, steps: int = 8,
         "compile_cache_hits": st["compile_cache_hits"],
         "compile_cache_hit_rate": st["compile_cache_hit_rate"],
         "dispatches": st["dispatches"],
+        # supervision counters (retry="solo" is active above): recovered
+        # and quarantined scenarios are part of the row, zeros included
+        # — a row that hides recovery traffic is reporting throughput
+        # for work that did not all succeed first try
+        "retry": st["retry"],
+        "solo_retries": st["solo_retries"],
+        "recovered_failures": st["recovered_failures"],
+        "quarantined": st["quarantined"],
+        "degraded_from": st["degraded_from"],
     }
     if verbose:
         print(f"  ensemble {impl} B={B}: "

@@ -525,6 +525,77 @@ def check_heavy_test(ctx: ModuleCtx):
             "it @pytest.mark.slow or set a module pytestmark)")
 
 
+# -- naked-save rule (ISSUE 5 satellite) --------------------------------------
+# Checkpoint durability now includes INTEGRITY: the manager's save path
+# writes per-array checksums and resume falls back to the newest step
+# that verifies. That guarantee holds only if every checkpoint write in
+# the package flows through the io writers / the supervisor-and-flush
+# boundaries — a module calling the raw writers (or a manager's .save)
+# from arbitrary code can reintroduce unverifiable checkpoints or break
+# the async staged-commit protocol.
+
+#: the raw checkpoint writers — callable only from the io layer itself
+CHECKPOINT_WRITERS = {"save_checkpoint", "save_checkpoint_sharded",
+                      "stage_checkpoint_sharded"}
+#: receiver names that read as a CheckpointManager (`mgr.save(...)`)
+_MANAGERISH = None  # compiled lazily; module-level re import kept local
+
+
+def _managerish():
+    global _MANAGERISH
+    if _MANAGERISH is None:
+        import re
+
+        _MANAGERISH = re.compile(r"(manager|mgr|ckpt)", re.IGNORECASE)
+    return _MANAGERISH
+
+
+def _save_boundary_module(ctx: ModuleCtx) -> bool:
+    """io/checkpoint.py, io/sharded.py and the resilience package are
+    the supervisor/flush boundaries the rule exempts."""
+    parts = ctx.resolved_parts
+    if "resilience" in parts:
+        return True
+    return (len(parts) >= 2 and parts[-2] == "io"
+            and parts[-1] in ("checkpoint.py", "sharded.py"))
+
+
+@rule("naked-save", Severity.ERROR,
+      "checkpoint writes outside the supervisor/flush boundaries must "
+      "go through CheckpointManager's checksum-writing path — raw "
+      "writer calls can reintroduce unverifiable checkpoints",
+      scope=SCOPE_PACKAGE)
+def check_naked_save(ctx: ModuleCtx):
+    if _save_boundary_module(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = _dotted_last(fn)
+        if name in CHECKPOINT_WRITERS:
+            yield Finding(
+                "naked-save", Severity.ERROR, ctx.path, node.lineno,
+                f"direct `{name}` call outside the io/resilience "
+                "boundaries — route the write through "
+                "CheckpointManager.save (the checksum-writing, "
+                "prune-aware path), or pragma a genuine low-level "
+                "boundary with its reason")
+        elif (name == "save" and isinstance(fn, ast.Attribute)
+              and (recv := _dotted_last(fn.value)) is not None
+              and _managerish().search(recv)):
+            # _dotted_last resolves chained receivers too (self.mgr.save,
+            # cfg.manager.save) — a stored manager must not bypass the rule
+            yield Finding(
+                "naked-save", Severity.ERROR, ctx.path, node.lineno,
+                f"`{recv}.save(...)` outside the supervisor/"
+                "flush boundaries — checkpoint cadence belongs to "
+                "resilience.supervised_run / io.run_checkpointed (they "
+                "carry the conservation baseline and commit staged "
+                "async writes); pragma a genuine boundary with its "
+                "reason")
+
+
 def audit_test_module(path) -> list[str]:
     """Marker-audit compatibility surface for
     ``tests/test_marker_audit.py``: ``["file.py::test_name", ...]`` for

@@ -45,6 +45,42 @@ def _parse_grid2(text, flag):
     return lines, columns
 
 
+def _parse_chaos(args):
+    """``--chaos=KIND[:N]`` → a seeded FaultPlan, or None. Kinds: ``exc``
+    (executor exception on chunk N), ``nan`` (NaN written into the state
+    after chunk N), ``halo`` (ghost-ring perturbation during chunk N —
+    sharded runs only), ``torn`` (the checkpoint written at step N is
+    torn on disk — requires --checkpoint-dir)."""
+    if args.chaos is None:
+        return None
+    from .resilience.inject import Fault, FaultPlan
+
+    spec = args.chaos
+    kind, _, at_s = spec.partition(":")
+    if kind not in ("exc", "nan", "halo", "torn"):
+        raise SystemExit(
+            f"--chaos={spec!r}: unknown kind {kind!r} (expected "
+            "exc|nan|halo|torn, optionally ':N' for the chunk/step to "
+            "fire at)")
+    try:
+        at = int(at_s) if at_s else None
+    except ValueError:
+        raise SystemExit(f"--chaos={spec!r}: {at_s!r} is not an integer")
+    sharded = args.mesh is not None or args.rectangular is not None
+    if kind == "halo" and not sharded:
+        raise SystemExit(
+            "--chaos=halo perturbs the ghost-ring exchange; add "
+            "--mesh=LxC (serial runs have no halos)")
+    if kind == "torn":
+        if args.checkpoint_dir is None:
+            raise SystemExit(
+                "--chaos=torn tears a written checkpoint; add "
+                "--checkpoint-dir=DIR")
+        tear = Fault("torn", at=at, tear="truncate", offset=64)
+        return FaultPlan((tear,), seed=args.chaos_seed)
+    return FaultPlan((Fault(kind, at=at),), seed=args.chaos_seed)
+
+
 def _compute_dtype(args):
     if args.compute_dtype is None:
         return None
@@ -215,6 +251,11 @@ def _run_ensemble(args, space, model) -> int:
         "batch_occupancy": st["batch_occupancy"],
         "compile_cache_hits": st["compile_cache_hits"],
         "dispatches": st["dispatches"],
+        # self-healing honesty (ISSUE 5): zeros on a clean run, but the
+        # row always says how many scenarios were recovered/quarantined
+        "recovered_failures": st["recovered_failures"],
+        "quarantined": st["quarantined"],
+        "solo_retries": st["solo_retries"],
     }
     if args.json:
         print(json.dumps(result, allow_nan=False))
@@ -279,6 +320,12 @@ def cmd_run(args) -> int:
     if args.ensemble is not None:
         if args.ensemble < 1:
             raise SystemExit(f"--ensemble={args.ensemble} needs B >= 1")
+        if args.chaos is not None:
+            raise SystemExit(
+                "--chaos drives the single-run supervised path; it does "
+                "not compose with --ensemble (drive ensemble chaos from "
+                "the API: resilience.inject + EnsembleScheduler("
+                "retry='solo'))")
         if sharded:
             raise SystemExit(
                 "--ensemble batches B whole scenarios into one device "
@@ -330,19 +377,32 @@ def cmd_run(args) -> int:
         raise SystemExit(
             "--checkpoint-layout/--async-checkpoints configure "
             "checkpointing; add --checkpoint-dir=DIR")
-    if args.checkpoint_dir:
+    chaos_plan = _parse_chaos(args)
+    injected = 0
+    if args.checkpoint_dir or chaos_plan is not None:
+        import contextlib
+
         from .io import CheckpointManager
         from .resilience import SimulationFailure, supervised_run
+        from .resilience import inject
 
+        # --chaos without --checkpoint-dir still runs SUPERVISED (the
+        # in-memory rollback path); a manager adds durability on top
+        manager = (CheckpointManager(args.checkpoint_dir,
+                                     layout=args.checkpoint_layout,
+                                     async_writes=args.async_checkpoints)
+                   if args.checkpoint_dir else None)
+        arm = (inject.armed(chaos_plan) if chaos_plan is not None
+               else contextlib.nullcontext())
+        arm_state = None
         try:
-            res = supervised_run(
-                model, space,
-                CheckpointManager(args.checkpoint_dir,
-                                  layout=args.checkpoint_layout,
-                                  async_writes=args.async_checkpoints),
-                steps=steps, every=args.checkpoint_every,
-                max_failures=args.max_failures, executor=executor,
-                on_event=events.append)
+            with arm as st:
+                arm_state = st
+                res = supervised_run(
+                    model, space, manager,
+                    steps=steps, every=args.checkpoint_every,
+                    max_failures=args.max_failures, executor=executor,
+                    on_event=events.append)
         except SimulationFailure as e:
             failure = str(e)
             events = e.events
@@ -350,6 +410,9 @@ def cmd_run(args) -> int:
             out = res.space
             # run-global baseline: survives resume via the checkpoint
             initial = res.initial_totals or initial
+        # the fired-fault log outlives disarm — reported even when the
+        # run failed (the row must say what chaos was actually injected)
+        injected = len(arm_state.fired) if arm_state is not None else 0
     else:
         # conservation judged HERE (status line + exit code), not raised
         # mid-flight — the CLI's contract is a conserved=false record
@@ -374,6 +437,7 @@ def cmd_run(args) -> int:
         result = {"backend": "sharded" if sharded else "serial",
                   "ranks": ranks, "steps": steps, "conserved": False,
                   "error": failure, "recovered_failures": len(events),
+                  "injected_faults": injected,
                   "wall_s": wall, **run_cfg}
         print(json.dumps(result) if args.json
               else f"FAILED after {len(events)} failure(s): {failure}")
@@ -415,6 +479,7 @@ def cmd_run(args) -> int:
         "conservation_error": err,
         "conserved": bool(err <= thresh),
         "recovered_failures": len(events),
+        "injected_faults": injected,
         "wall_s": wall,
         **run_cfg,
     }
@@ -560,6 +625,17 @@ def main(argv: Optional[list[str]] = None) -> int:
                      help="overlap checkpoint writes with compute "
                           "(requires --checkpoint-layout=sharded)")
     run.add_argument("--max-failures", type=int, default=3)
+    run.add_argument("--chaos", default=None, metavar="KIND[:N]",
+                     help="arm a deterministic fault plan against the "
+                     "supervised run and prove it heals: exc|nan inject "
+                     "an executor exception / NaN state at chunk N, "
+                     "halo perturbs one ghost exchange (sharded runs), "
+                     "torn tears the checkpoint written at step N "
+                     "(with --checkpoint-dir); the run reports "
+                     "injected_faults and recovered_failures")
+    run.add_argument("--chaos-seed", type=int, default=0,
+                     help="seed for the fault plan's derived "
+                     "perturbation values (reproducible chaos)")
     run.add_argument("--output", default=None,
                      help="write the reference-parity per-rank dump + "
                      "merged output file to this directory")

@@ -19,10 +19,12 @@ from .batch import (
     run_ensemble,
     structure_key,
 )
-from .scheduler import DEFAULT_BUCKETS, EnsembleScheduler, buckets_for
+from .scheduler import (DEFAULT_BUCKETS, DispatchTimeout,
+                        EnsembleScheduler, buckets_for)
 from .service import EnsembleService
 
 __all__ = [
+    "DispatchTimeout",
     "EnsembleConservationError",
     "EnsembleExecutor",
     "EnsembleScheduler",

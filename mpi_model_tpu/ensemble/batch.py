@@ -50,6 +50,7 @@ import numpy as np
 from ..core.cellular_space import CellularSpace, first_float_dtype
 from ..models.model import (ConservationError, Model, Report,
                             default_conservation_rtol)
+from ..resilience import inject
 from ..ops.flow import Diffusion, PointFlow, build_outflow
 from ..ops.stencil import neighbor_counts_traced, point_flow_step, transport
 
@@ -316,10 +317,13 @@ def conservation_violations(initial: dict[str, np.ndarray],
                             count: int) -> tuple[np.ndarray, list[int]]:
     """(per-lane max |Δtotal| errors ``[B]``, violating lane indices
     ``< count``). Lanes at index >= ``count`` are padding and never
-    counted."""
+    counted. A NON-FINITE lane error (a NaN/Inf-poisoned lane makes its
+    total NaN) is always a violation: ``NaN > threshold`` is False, so
+    a plain comparison would wave the worst possible state through."""
     errs = np.max(np.abs(np.stack(
         [final[k] - initial[k] for k in initial], axis=0)), axis=0)
-    bad = np.nonzero(errs[:count] > thresholds[:count])[0]
+    head = errs[:count]
+    bad = np.nonzero((head > thresholds[:count]) | ~np.isfinite(head))[0]
     return errs, [int(i) for i in bad]
 
 
@@ -328,8 +332,12 @@ def _violation_error(errs: np.ndarray, thresholds: np.ndarray, i: int,
                      count: Optional[int] = None
                      ) -> EnsembleConservationError:
     """The one place the per-lane violation message is built."""
-    msg = (f"mass conservation violated in scenario {i}: |Δ| = "
-           f"{errs[i]:.3e} > {thresholds[i]:.3e}")
+    if not np.isfinite(errs[i]):
+        msg = (f"non-finite state in scenario {i}: its channel totals "
+               "are NaN/Inf (divergence or a poisoned lane)")
+    else:
+        msg = (f"mass conservation violated in scenario {i}: |Δ| = "
+               f"{errs[i]:.3e} > {thresholds[i]:.3e}")
     if nbad is not None:
         msg += f" ({nbad} of {count} scenarios violated)"
     return EnsembleConservationError(msg, scenario=i)
@@ -676,6 +684,14 @@ def run_ensemble(model, spaces, *, models=None, executor=None, steps=None,
         out, (fb_b, at_b) = out
         fb_arr = np.asarray(fb_b)
         at_arr = np.asarray(at_b)
+    # chaos seam (resilience.inject): an armed lane_nan fault writes
+    # NaN into a scenario lane's OUTPUT here — upstream of the totals,
+    # so the per-lane conservation machinery must catch it exactly the
+    # way it would catch a genuinely diverged lane
+    st = inject.active()
+    if st is not None:
+        for lane, fault in st.ensemble_poisons(st.bump("ensemble")):
+            out = inject.poison_lane_values(out, lane, fault)
     final_d = batched_totals(out)
     executor.last_impl = executor.impl
     executor.last_backend_report = None

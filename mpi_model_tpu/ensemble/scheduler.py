@@ -19,9 +19,23 @@ structure)`` — sees a handful of batch shapes instead of one per
 traffic pattern: any load is served with at most ``len(buckets)``
 compiles per structure.
 
-``clock`` is injectable (tests drive the max-wait policy with a fake
+``clock`` is injectable (tests drive the max-wait policy — and the
+dispatch deadline, via the chaos harness's ``hang`` fault — with a fake
 clock); wall times for the throughput counters always come from
 ``time.perf_counter``.
+
+Self-healing (ISSUE 5): with ``retry="solo"`` a failed scenario is
+re-dispatched ALONE once to distinguish a scenario fault from a batch
+fault — a solo success means the batch (impl/dispatch level) was at
+fault and the scenario's result is recovered; a solo failure means the
+scenario itself is poisoned and it is QUARANTINED with a
+``FailureEvent`` (batchmates are never retried — their results, good or
+bad, stand). Repeated impl-level faults engage the degradation ladder:
+``pipeline`` → ``xla`` and ``active`` → ``xla`` (the dense vmapped
+step), reported through ``stats()``/``backend_report`` rather than
+silently. ``dispatch_deadline_s`` bounds a dispatch by the injectable
+clock: an overrun (a hung dispatch) is a ``DispatchTimeout`` handled
+through the same retry/quarantine machinery.
 """
 
 from __future__ import annotations
@@ -30,15 +44,24 @@ import collections
 import dataclasses
 import itertools
 import time
+import warnings
 from typing import Callable, Optional, Sequence
 
 from ..core.cellular_space import CellularSpace
+from ..resilience import inject
 from ..utils.metrics import ThroughputCounter
 from .batch import (EnsembleExecutor, padding_scenarios, run_ensemble,
                     structure_key)
 
 #: default bucket ladder: pad k scenarios up to the smallest entry >= k
 DEFAULT_BUCKETS = (1, 2, 4, 8)
+
+
+class DispatchTimeout(RuntimeError):
+    """A dispatch overran ``dispatch_deadline_s`` by the scheduler's
+    (injectable) clock — the serving layer's view of a hung dispatch.
+    Its results are discarded; the affected tickets are retried solo or
+    failed, per the retry policy."""
 
 
 def buckets_for(n: int) -> tuple[int, ...]:
@@ -73,7 +96,14 @@ class EnsembleScheduler:
                  compute_dtype=None, check_conservation: bool = True,
                  tolerance: float = 1e-3, rtol: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 counter: Optional[ThroughputCounter] = None):
+                 counter: Optional[ThroughputCounter] = None,
+                 retry: str = "none",
+                 dispatch_deadline_s: Optional[float] = None,
+                 degrade_after: int = 2):
+        if retry not in ("none", "solo"):
+            raise ValueError(
+                f"unknown retry policy {retry!r} (expected 'none' or "
+                "'solo')")
         bl = tuple(sorted({int(b) for b in buckets}))
         if not bl or bl[0] < 1:
             raise ValueError(f"buckets must be positive ints, got {buckets}")
@@ -91,6 +121,19 @@ class EnsembleScheduler:
         self.rtol = rtol
         self.counter = counter if counter is not None else ThroughputCounter()
         self._clock = clock
+        #: "none" (first failure surfaces at poll — the pre-ISSUE-5
+        #: behavior) or "solo" (retry-with-quarantine, module docstring)
+        self.retry = retry
+        #: deadline per dispatch by the injectable clock (None = off)
+        self.dispatch_deadline_s = dispatch_deadline_s
+        #: impl-level faults tolerated before the degradation ladder
+        #: swaps pipeline/active for the always-eligible "xla" engine
+        self.degrade_after = int(degrade_after)
+        #: the impl the ladder degraded AWAY from (None = never engaged)
+        self.degraded_from: Optional[str] = None
+        self._impl_fault_count = 0
+        #: one FailureEvent per quarantined scenario, in quarantine order
+        self.quarantine_log: list = []
         self._queues: collections.OrderedDict[tuple, list[_Pending]] = \
             collections.OrderedDict()
         self._results: dict[int, object] = {}
@@ -191,6 +234,76 @@ class EnsembleScheduler:
         else:
             del self._queues[key]
         bucket = next(b for b in self.buckets if b >= k)
+        results, whole_err, cache_hit, wall = self._execute_batch(
+            items, bucket)
+        if whole_err is not None:
+            # impl/dispatch-level fault (pipeline ineligibility, device
+            # fault, injected batch fault, deadline overrun): feeds the
+            # degradation ladder, then either the solo-retry machinery
+            # serves each lane or — policy "none" — every affected
+            # ticket re-raises this error when polled. submit()/poll()
+            # on OTHER tickets keep working either way.
+            self._note_impl_fault(whole_err)
+            self.dispatch_log.append({
+                "bucket": bucket, "count": k, "occupancy": k / bucket,
+                "steps": items[0].steps,
+                "tickets": [it.ticket for it in items],
+                "cache_hit": cache_hit, "wall_s": wall,
+                "error": f"{type(whole_err).__name__}: {whole_err}",
+            })
+            if self.retry == "solo":
+                for it in items:
+                    self._serve_solo(it, whole_err, batch_level=True)
+                return
+            for it in items:
+                self._results[it.ticket] = whole_err
+                self._pending_tickets.discard(it.ticket)
+            return
+        retried: list[int] = []
+        for it, res in zip(items, results):
+            if isinstance(res, Exception) and self.retry == "solo":
+                if k > 1:
+                    # a failed scenario in a batch: re-dispatch it solo
+                    # once — its batchmates' results (above/below this
+                    # line) are never touched
+                    retried.append(it.ticket)
+                else:
+                    # it already ran alone: nothing left to distinguish
+                    self._quarantine(it, res, attempts=1)
+                continue
+            if isinstance(res, Exception):
+                res.ticket = it.ticket
+            self._results[it.ticket] = res
+            self._pending_tickets.discard(it.ticket)
+        entry = {
+            "bucket": bucket, "count": k, "occupancy": k / bucket,
+            "steps": items[0].steps,
+            "tickets": [it.ticket for it in items],
+            "cache_hit": cache_hit, "wall_s": wall,
+        }
+        if retried:
+            # an auditor reading the log must be able to reconcile it
+            # with stats(): this dispatch was NOT clean — these lanes
+            # failed and went to solo retries (logged as their own
+            # entries below)
+            entry["retried_solo"] = list(retried)
+        self.dispatch_log.append(entry)
+        # retries run AFTER the batch entry so the log reads in
+        # dispatch order (batch, then its solos)
+        by_ticket = {it.ticket: (it, res)
+                     for it, res in zip(items, results)}
+        for t in retried:
+            it, res = by_ticket[t]
+            self._serve_solo(it, res, batch_level=False)
+
+    def _execute_batch(self, items: list, bucket: int):
+        """One physical dispatch of ``items`` padded to ``bucket``:
+        ``(results, whole_err, cache_hit, wall)`` — ``results`` aligned
+        with ``items`` (lane errors marked), or None with ``whole_err``
+        set when the dispatch itself failed or overran its deadline.
+        Serving counters are recorded here, so solo retries bill like
+        any other dispatch."""
+        k = len(items)
         template = items[0].model
         spaces = [it.space for it in items]
         models = [it.model for it in items]
@@ -199,8 +312,30 @@ class EnsembleScheduler:
                                                  bucket - k)
             spaces += pspaces
             models += pmodels
+        # chaos seams (resilience.inject): ticket-bound lane poisons are
+        # mapped to lane indices for run_ensemble's output seam;
+        # "batch_exc" fails this whole dispatch; "hang" stretches its
+        # clock duration past the deadline
+        st = inject.active()
+        didx = st.bump("dispatch") if st is not None else None
+        pushed = False
+        if st is not None:
+            poisons = []
+            for i, it in enumerate(items):
+                f = st.ticket_fault(it.ticket)
+                if f is not None:
+                    poisons.append((i, f))
+            if poisons:
+                st.push_lane_poisons(poisons)
+                pushed = True
         builds0 = self.executor.builds
+        c0 = self._clock()
         try:
+            if st is not None:
+                bf = st.take("dispatch", didx, kinds=("batch_exc",))
+                if bf is not None:
+                    raise inject.InjectedFault(
+                        f"injected batch fault on dispatch {didx}")
             results = run_ensemble(
                 template, spaces, models=models, executor=self.executor,
                 steps=items[0].steps,
@@ -211,21 +346,10 @@ class EnsembleScheduler:
         # whole-batch failure must fan out to the affected tickets
         # instead of stranding them or leaking into an unrelated caller
         except Exception as e:
-            # a whole-dispatch failure (e.g. pipeline ineligibility)
-            # must not strand its tickets OR leak out of an unrelated
-            # caller: submit()/poll() on OTHER tickets keep working, and
-            # each affected ticket re-raises this error when polled
-            for it in items:
-                self._results[it.ticket] = e
-                self._pending_tickets.discard(it.ticket)
-            self.dispatch_log.append({
-                "bucket": bucket, "count": k, "occupancy": k / bucket,
-                "steps": items[0].steps,
-                "tickets": [it.ticket for it in items],
-                "cache_hit": False, "wall_s": 0.0,
-                "error": f"{type(e).__name__}: {e}",
-            })
-            return
+            return None, e, False, 0.0
+        finally:
+            if pushed:
+                st.clear_lane_poisons()
         cache_hit = self.executor.builds == builds0
         # the batch wall time: from any served lane's Report, else from
         # a marked violation (run_ensemble stamps it there too, so a
@@ -236,19 +360,122 @@ class EnsembleScheduler:
                 wall = res[1].wall_time_s
                 break
             wall = getattr(res, "wall_time_s", 0.0) or wall
-        for it, res in zip(items, results):
-            if isinstance(res, Exception):
-                res.ticket = it.ticket
-            self._results[it.ticket] = res
-            self._pending_tickets.discard(it.ticket)
+        duration = self._clock() - c0
+        if st is not None:
+            hf = st.take("dispatch", didx, kinds=("hang",))
+            if hf is not None:
+                duration += hf.seconds
+        if (self.dispatch_deadline_s is not None
+                and duration > self.dispatch_deadline_s):
+            # a hung dispatch: its results are not trustworthy (and a
+            # real hang would never have produced any) — discarded, not
+            # served; scenarios are NOT billed to the counters
+            return None, DispatchTimeout(
+                f"dispatch overran its {self.dispatch_deadline_s}s "
+                f"deadline ({duration:.3f}s by the scheduler clock)"
+            ), cache_hit, wall
         self.counter.record_dispatch(scenarios=k, bucket=bucket,
                                      wall_s=wall, cache_hit=cache_hit)
-        self.dispatch_log.append({
-            "bucket": bucket, "count": k, "occupancy": k / bucket,
-            "steps": items[0].steps,
-            "tickets": [it.ticket for it in items],
-            "cache_hit": cache_hit, "wall_s": wall,
-        })
+        if self.degraded_from is not None:
+            # per-row honesty: results served by a degraded engine say
+            # so — a consumer must never believe pipeline/active served
+            # them after the ladder swapped the engine out
+            for res in results:
+                if not isinstance(res, Exception):
+                    rep = res[1]
+                    rep.backend_report = {
+                        **(rep.backend_report or {}),
+                        "impl": self.executor.impl,
+                        "degraded_from": self.degraded_from,
+                    }
+        return results, None, cache_hit, wall
+
+    def _serve_solo(self, it: _Pending, cause: Exception,
+                    batch_level: bool) -> None:
+        """Re-dispatch one failed scenario ALONE (once): success means
+        the original failure was the batch's — the scenario recovers;
+        failure means the scenario itself is at fault — quarantine.
+        Solo dispatches get their own ``dispatch_log`` entries, so the
+        log stays reconcilable with the ``dispatches``/``solo_retries``
+        counters."""
+        self.counter.solo_retries += 1
+        results, whole_err, cache_hit, wall = self._execute_batch(
+            [it], self.buckets[0])
+        err = whole_err
+        if err is None and isinstance(results[0], Exception):
+            err = results[0]
+        entry = {
+            "bucket": self.buckets[0], "count": 1,
+            "occupancy": 1 / self.buckets[0], "steps": it.steps,
+            "tickets": [it.ticket], "cache_hit": cache_hit,
+            "wall_s": wall, "solo_retry": True,
+            "outcome": "recovered" if err is None else "quarantined",
+        }
+        if err is not None:
+            entry["error"] = f"{type(err).__name__}: {err}"
+        self.dispatch_log.append(entry)
+        if err is None:
+            self.counter.recovered_failures += 1
+            if not batch_level:
+                # a lane failure that vanishes when the scenario runs
+                # alone is evidence of a BATCH-level fault — feed the
+                # degradation ladder (whole-batch failures already did)
+                self._note_impl_fault(cause)
+            self._results[it.ticket] = results[0]
+            self._pending_tickets.discard(it.ticket)
+            return
+        if whole_err is not None:
+            self._note_impl_fault(whole_err)
+        self._quarantine(it, err, attempts=2)
+
+    def _quarantine(self, it: _Pending, err: Exception,
+                    attempts: int) -> None:
+        """Isolate a deterministically failing scenario: its error (with
+        a complete ``FailureEvent``) is what ``poll`` raises; nothing is
+        retried again."""
+        from ..resilience import FailureEvent
+
+        msg = str(err)
+        if isinstance(err, DispatchTimeout):
+            kind = "timeout"
+        elif "non-finite" in msg:
+            kind = "nonfinite"
+        elif "conservation" in msg:
+            kind = "conservation"
+        else:
+            kind = "exception"
+        ev = FailureEvent(
+            step=it.steps, kind=kind,
+            detail=f"{type(err).__name__}: {err}",
+            rolled_back_to=0, attempt=attempts, wall_time_s=0.0,
+            classification="deterministic", ticket=it.ticket)
+        self.quarantine_log.append(ev)
+        self.counter.quarantined += 1
+        err.ticket = it.ticket
+        err.failure_event = ev
+        self._results[it.ticket] = err
+        self._pending_tickets.discard(it.ticket)
+
+    def _note_impl_fault(self, err: Exception) -> None:
+        """Count an impl/dispatch-level fault toward the degradation
+        ladder; at ``degrade_after`` the executor degrades to the
+        always-eligible dense engine (``pipeline`` → ``xla``,
+        ``active`` → ``xla``) — announced, counted, and stamped onto
+        every subsequently served report."""
+        self.counter.impl_faults += 1
+        self._impl_fault_count += 1
+        if (self.degraded_from is None
+                and self._impl_fault_count >= self.degrade_after
+                and self.executor.impl in ("pipeline", "active")):
+            old = self.executor.impl
+            self.degraded_from = old
+            self.executor = EnsembleExecutor(
+                impl="xla", substeps=self.executor.substeps,
+                compute_dtype=self.executor.compute_dtype)
+            warnings.warn(
+                f"ensemble impl {old!r} degraded to 'xla' after "
+                f"{self._impl_fault_count} impl-level dispatch fault(s) "
+                f"(last: {type(err).__name__}: {err})", RuntimeWarning)
 
     # -- observability -------------------------------------------------------
 
@@ -263,5 +490,7 @@ class EnsembleScheduler:
             "impl": self.executor.impl,
             "substeps": self.executor.substeps,
             "buckets": list(self.buckets),
+            "retry": self.retry,
+            "degraded_from": self.degraded_from,
         })
         return out

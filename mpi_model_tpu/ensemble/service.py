@@ -26,7 +26,10 @@ class EnsembleService:
     ``steps`` sets the default per-submission step count (falling back
     to the template's ``time/time_step`` schedule); all other keyword
     arguments configure the scheduler (impl, substeps, buckets,
-    max_wait_s, max_batch, conservation policy, clock).
+    max_wait_s, max_batch, conservation policy, clock, and the
+    self-healing knobs: ``retry="solo"`` for retry-with-quarantine,
+    ``dispatch_deadline_s`` for the hung-dispatch bound,
+    ``degrade_after`` for the impl degradation ladder).
     """
 
     def __init__(self, model, *, steps: Optional[int] = None,
@@ -35,7 +38,10 @@ class EnsembleService:
                  max_wait_s: float = 0.0, max_batch: Optional[int] = None,
                  compute_dtype=None, check_conservation: bool = True,
                  tolerance: float = 1e-3, rtol: Optional[float] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 retry: str = "none",
+                 dispatch_deadline_s: Optional[float] = None,
+                 degrade_after: int = 2):
         self.model = model
         self.default_steps = (model.num_steps if steps is None
                               else int(steps))
@@ -44,7 +50,9 @@ class EnsembleService:
             max_wait_s=max_wait_s, max_batch=max_batch,
             compute_dtype=compute_dtype,
             check_conservation=check_conservation, tolerance=tolerance,
-            rtol=rtol, clock=clock)
+            rtol=rtol, clock=clock, retry=retry,
+            dispatch_deadline_s=dispatch_deadline_s,
+            degrade_after=degrade_after)
 
     def submit(self, space: CellularSpace, *, model=None,
                steps: Optional[int] = None) -> int:

@@ -8,9 +8,14 @@ names the natural seed for a real design. Here that becomes:
 - one self-contained ``.npz`` per checkpoint holding every attribute
   channel as raw little-endian bytes (dtype-safe for bfloat16, which
   plain ``np.savez`` can't store without pickling) plus a JSON metadata
-  record (geometry, step counter, user extras);
+  record (geometry, step counter, user extras, per-channel CRC32);
 - atomic writes (tmp + ``os.replace``) so a crash mid-save never
-  corrupts the latest checkpoint;
+  corrupts the latest checkpoint — and per-array checksums so a
+  checkpoint torn/corrupted AFTER the rename (disk fault, chaos
+  injection) is DETECTED at restore instead of silently resuming bad
+  state: every unreadable or checksum-failing read raises
+  ``CheckpointCorruptionError``, and ``CheckpointManager.latest()``
+  falls back to the newest checkpoint that VERIFIES;
 - ``CheckpointManager`` for periodic save / prune / resume-from-latest;
 - ``run_checkpointed`` — the chunked execute loop proving
   resume-equivalence (restart produces bit-identical state).
@@ -31,6 +36,8 @@ import dataclasses
 import json
 import os
 import tempfile
+import warnings
+import zlib
 from typing import Any, Optional
 
 import jax
@@ -38,8 +45,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.cellular_space import CellularSpace
+from ..resilience import inject
 
 FORMAT_VERSION = 1
+
+
+class CheckpointCorruptionError(ValueError):
+    """A checkpoint failed verification at restore: unreadable (torn
+    write, truncated archive) or a channel's bytes no longer match the
+    CRC32 recorded when they were written. ``CheckpointManager.latest``
+    treats this as "fall back to the previous verified step"; an
+    explicit ``restore(step)`` propagates it."""
 
 
 @dataclasses.dataclass
@@ -77,8 +93,12 @@ def save_checkpoint(path: str, space: CellularSpace, step: int = 0,
     payload: dict[str, np.ndarray] = {}
     for name, arr in space.values.items():
         a = np.ascontiguousarray(gather_global(arr))
-        meta["channels"][name] = {"dtype": str(a.dtype), "shape": a.shape}
-        payload[f"ch:{name}"] = a.reshape(-1).view(np.uint8)
+        raw = a.reshape(-1).view(np.uint8)
+        # per-array CRC32: restore verifies bytes against it, so a
+        # torn/bit-rotted checkpoint is detected instead of resumed
+        meta["channels"][name] = {"dtype": str(a.dtype), "shape": a.shape,
+                                  "crc32": zlib.crc32(raw) & 0xFFFFFFFF}
+        payload[f"ch:{name}"] = raw
     payload["meta"] = np.frombuffer(
         json.dumps(meta).encode("utf-8"), dtype=np.uint8)
 
@@ -98,23 +118,57 @@ def save_checkpoint(path: str, space: CellularSpace, step: int = 0,
                 if os.path.exists(tmp):
                     os.unlink(tmp)
                 raise
+            # chaos seam (resilience.inject): an armed "torn" fault
+            # damages the just-committed file — the checksum/fallback
+            # machinery below is what it exists to exercise
+            inject.checkpoint_torn(path, int(step))
     return path
 
 
 def load_checkpoint(path: str) -> Checkpoint:
-    """Restore a checkpoint written by ``save_checkpoint``."""
-    with np.load(path) as z:
-        meta = json.loads(bytes(z["meta"]).decode("utf-8"))
-        if meta.get("format") != FORMAT_VERSION:
-            raise ValueError(
-                f"unsupported checkpoint format {meta.get('format')!r} "
-                f"in {path} (expected {FORMAT_VERSION})")
-        values = {}
-        for name, ch in meta["channels"].items():
-            dtype = jnp.dtype(ch["dtype"])  # jnp: resolves bfloat16 too
-            raw = bytes(z[f"ch:{name}"])
-            values[name] = jnp.asarray(
-                np.frombuffer(raw, dtype=dtype).reshape(ch["shape"]))
+    """Restore a checkpoint written by ``save_checkpoint``; raises
+    ``CheckpointCorruptionError`` when the file is unreadable (torn
+    write) or any channel fails its recorded checksum."""
+    import zipfile
+
+    values = {}
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+            fmt = meta.get("format")
+            if fmt == FORMAT_VERSION:
+                # per channel: read raw bytes, verify, build the array,
+                # DROP the bytes — peak host memory stays one channel
+                # over the final state, not a second full copy
+                for name, ch in meta.get("channels", {}).items():
+                    raw = bytes(z[f"ch:{name}"])
+                    want = ch.get("crc32")
+                    if (want is not None
+                            and (zlib.crc32(raw) & 0xFFFFFFFF) != want):
+                        raise CheckpointCorruptionError(
+                            f"channel {name!r} in {path} fails its "
+                            "CRC32 (bytes changed since the checkpoint "
+                            "was written)")
+                    dtype = jnp.dtype(ch["dtype"])  # resolves bfloat16
+                    values[name] = jnp.asarray(np.frombuffer(
+                        raw, dtype=dtype).reshape(ch["shape"]))
+    except CheckpointCorruptionError:
+        raise
+    except FileNotFoundError:
+        raise
+    except (zipfile.BadZipFile, EOFError, KeyError, OSError,
+            ValueError) as e:
+        # a torn/truncated archive surfaces as any of these depending on
+        # where the damage landed (central directory, a member, the
+        # meta json, a short buffer in frombuffer); they all mean the
+        # same thing at this boundary
+        raise CheckpointCorruptionError(
+            f"checkpoint {path} is torn/unreadable: "
+            f"{type(e).__name__}: {e}") from e
+    if fmt != FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported checkpoint format {fmt!r} "
+            f"in {path} (expected {FORMAT_VERSION})")
     space = CellularSpace(
         values, meta["dim_x"], meta["dim_y"], meta["x_init"], meta["y_init"],
         meta["global_dim_x"], meta["global_dim_y"])
@@ -171,8 +225,6 @@ class CheckpointManager:
         fallback = self.path_for(step, other)
         if os.path.exists(preferred):
             if os.path.exists(fallback):
-                import warnings
-
                 warnings.warn(
                     f"step {step} exists in BOTH layouts "
                     f"({os.path.basename(preferred)} and "
@@ -302,10 +354,31 @@ class CheckpointManager:
                         shutil.rmtree(p, ignore_errors=True)
 
     def latest(self, *, mesh=None, spec=None) -> Optional[Checkpoint]:
+        """The newest checkpoint that VERIFIES. A torn/corrupt newest
+        step (``CheckpointCorruptionError`` — failed CRC32, unreadable
+        archive, incomplete shard coverage) falls back to the next-older
+        step instead of crashing resume — the commit-by-vote discipline
+        extended to integrity, not just presence. None when the
+        directory holds no checkpoints; raises when every step on disk
+        fails verification (resuming from nothing would silently discard
+        the run's durable history)."""
         steps = self.steps()
         if not steps:
             return None
-        return self.restore(steps[-1], mesh=mesh, spec=spec)
+        last_err: Optional[CheckpointCorruptionError] = None
+        for step in reversed(steps):
+            try:
+                return self.restore(step, mesh=mesh, spec=spec)
+            except CheckpointCorruptionError as e:
+                warnings.warn(
+                    f"checkpoint step {step} failed verification ({e}); "
+                    "falling back to the previous verified checkpoint",
+                    RuntimeWarning, stacklevel=2)
+                last_err = e
+        raise CheckpointCorruptionError(
+            f"no verifiable checkpoint in {self.directory}: all "
+            f"{len(steps)} step(s) on disk failed verification "
+            f"(newest error: {last_err})") from last_err
 
     def restore(self, step: int, *, mesh=None, spec=None) -> Checkpoint:
         path = self._on_disk(step)

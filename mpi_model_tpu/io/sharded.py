@@ -30,6 +30,7 @@ import dataclasses
 import json
 import os
 import tempfile
+import zlib
 from typing import Any, Mapping, Optional, Union
 
 import jax
@@ -37,7 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.cellular_space import CellularSpace
-from .checkpoint import Checkpoint
+from ..resilience import inject
+from .checkpoint import Checkpoint, CheckpointCorruptionError
 
 SHARDED_FORMAT_VERSION = 1
 MANIFEST = "manifest.json"
@@ -75,8 +77,12 @@ class StagedShardSave:
     _proc: int
 
     def write(self) -> None:
-        _atomic_write(os.path.join(self.path, _shard_file(self._proc)),
-                      lambda f: np.savez(f, **self._payload))
+        target = os.path.join(self.path, _shard_file(self._proc))
+        _atomic_write(target, lambda f: np.savez(f, **self._payload))
+        # chaos seam (resilience.inject): an armed "torn" fault damages
+        # this process's just-written shard file — the per-piece CRC32s
+        # and latest()'s verified fallback are what it exercises
+        inject.checkpoint_torn(target, int(self.manifest["step"]))
 
 
 def stage_checkpoint_sharded(path: str, space: CellularSpace, step: int = 0,
@@ -123,9 +129,13 @@ def stage_checkpoint_sharded(path: str, space: CellularSpace, step: int = 0,
                 shape.append(hi - lo)
             data = np.ascontiguousarray(shard.data)
             key = f"d:{len(pieces)}"
+            raw = data.reshape(-1).view(np.uint8)
+            # per-piece CRC32 (the dense format's per-array checksum at
+            # shard granularity): restore verifies each piece it reads
             pieces.append({"channel": name, "start": starts, "shape": shape,
-                           "key": key})
-            payload[key] = data.reshape(-1).view(np.uint8)
+                           "key": key,
+                           "crc32": zlib.crc32(raw) & 0xFFFFFFFF})
+            payload[key] = raw
     payload["meta"] = np.frombuffer(
         json.dumps({"pieces": pieces}).encode("utf-8"), dtype=np.uint8)
     manifest = {
@@ -156,10 +166,16 @@ def commit_checkpoint_sharded(staged: StagedShardSave) -> str:
     sync("sharded-ckpt-shards")
     with master_only("sharded-ckpt-manifest") as master:
         if master:
+            mpath = os.path.join(staged.path, MANIFEST)
             _atomic_write(
-                os.path.join(staged.path, MANIFEST),
+                mpath,
                 lambda f: f.write(
                     json.dumps(staged.manifest, indent=1).encode()))
+            # chaos seam: a "torn" fault with channel="manifest" damages
+            # the commit record itself (an unreadable manifest = an
+            # incomplete checkpoint; resume must fall back past it)
+            inject.checkpoint_torn(mpath, int(staged.manifest["step"]),
+                                   part="manifest")
     return staged.path
 
 
@@ -214,12 +230,29 @@ class _ShardFileReader:
     members unread until indexed)."""
 
     def __init__(self, path: str):
-        self._z = np.load(path)
-        self.pieces = json.loads(bytes(self._z["meta"]).decode("utf-8"))[
-            "pieces"]
+        import zipfile
+
+        self.path = path
+        try:
+            self._z = np.load(path)
+            self.pieces = json.loads(
+                bytes(self._z["meta"]).decode("utf-8"))["pieces"]
+        except (zipfile.BadZipFile, EOFError, KeyError, OSError,
+                ValueError) as e:
+            # a torn shard file is corruption, typed so latest() can
+            # fall back to the previous verified step
+            raise CheckpointCorruptionError(
+                f"shard file {path} is torn/unreadable: "
+                f"{type(e).__name__}: {e}") from e
 
     def read(self, piece: dict, dtype) -> np.ndarray:
         raw = self._z[piece["key"]]
+        want = piece.get("crc32")
+        if want is not None and (zlib.crc32(raw) & 0xFFFFFFFF) != want:
+            raise CheckpointCorruptionError(
+                f"piece {piece['key']} (channel {piece['channel']!r}) in "
+                f"{self.path} fails its CRC32 (bytes changed since the "
+                "shard was written)")
         return raw.view(dtype).reshape(piece["shape"])
 
     def close(self) -> None:
@@ -255,7 +288,10 @@ def _assemble(readers: list[_ShardFileReader], channel: str, dtype,
             out[tuple(dst_sel)] = data[tuple(src_sel)]
             covered[tuple(dst_sel)] = True
     if not covered.all():
-        raise ValueError(
+        # incomplete coverage = a corrupt/mismatched checkpoint, typed
+        # so latest() falls back (subclasses ValueError — callers that
+        # caught the old type still do)
+        raise CheckpointCorruptionError(
             f"sharded checkpoint does not cover channel {channel!r} region "
             f"start={region_start} shape={region_shape} "
             f"({int(covered.sum())}/{covered.size} cells present)")
@@ -283,8 +319,13 @@ def load_checkpoint_sharded(
     if not os.path.exists(mpath):
         raise FileNotFoundError(
             f"no {MANIFEST} in {path}: not a (complete) sharded checkpoint")
-    with open(mpath) as f:
-        manifest = json.load(f)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError, OSError) as e:
+        raise CheckpointCorruptionError(
+            f"manifest in {path} is torn/unreadable: "
+            f"{type(e).__name__}: {e}") from e
     if manifest.get("format") != SHARDED_FORMAT_VERSION:
         raise ValueError(
             f"unsupported sharded checkpoint format "

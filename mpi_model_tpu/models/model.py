@@ -37,6 +37,7 @@ from ..core.cell import MOORE_OFFSETS
 from ..core.cellular_space import CellularSpace
 from ..ops.flow import Flow, PointFlow, build_outflow
 from ..ops.stencil import neighbor_counts_traced, point_flow_step, transport
+from ..resilience import inject
 
 Values = dict[str, jax.Array]
 
@@ -143,6 +144,28 @@ class SerialExecutor:
 
     def run_model(self, model: "Model", space: CellularSpace,
                   num_steps: int) -> Values:
+        # chaos seam (resilience.inject): one module-global read when no
+        # plan is armed — the jitted runners below are untouched, so the
+        # step jaxprs are identical to an uninstrumented build
+        st = inject.active()
+        if st is None:
+            return self._run_inner(model, space, num_steps)
+        idx = st.bump("executor")
+        fault = st.take("executor", idx, kinds=("exc", "nan"))
+        if fault is not None and fault.kind == "exc":
+            # the call index rides the message so two injected faults
+            # never share a failure signature (that would read as ONE
+            # deterministic fault to the supervisor's classifier)
+            raise inject.InjectedFault(
+                f"injected executor fault on call {idx} "
+                f"({num_steps}-step chunk)")
+        out = self._run_inner(model, space, num_steps)
+        if fault is not None:  # kind == "nan": poison the chunk OUTPUT
+            out = inject.poison_values(out, fault, st.plan)
+        return out
+
+    def _run_inner(self, model: "Model", space: CellularSpace,
+                   num_steps: int) -> Values:
         #: per-run report detail (Report.backend_report) — reset so a
         #: previous run's composed/active record never leaks forward
         self.last_backend_report = None

@@ -46,6 +46,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..compat import shard_map
 from ..core.cellular_space import CellularSpace
 from ..ops.flow import PointFlow
+from ..resilience import inject
 from .halo import gather_from_padded, pad_with_halo_1d, pad_with_halo_2d
 from .mesh import grid_spec, put_global
 
@@ -282,6 +283,32 @@ class ShardMapExecutor:
         return None
 
     def run_model(self, model, space: CellularSpace, num_steps: int) -> Values:
+        # chaos seam (resilience.inject): one module-global read when no
+        # plan is armed; "halo" arms the trace-time ghost-ring
+        # perturbation for exactly this chunk (the perturbed runner is
+        # cached under a distinct build token, so the clean cache is
+        # never poisoned)
+        st = inject.active()
+        if st is None:
+            return self._run_inner(model, space, num_steps)
+        idx = st.bump("executor")
+        fault = st.take("executor", idx, kinds=("exc", "nan", "halo"))
+        if fault is None:
+            return self._run_inner(model, space, num_steps)
+        if fault.kind == "exc":
+            # call index in the message: distinct signatures per
+            # injection (see SerialExecutor.run_model)
+            raise inject.InjectedFault(
+                f"injected executor fault on call {idx} (sharded "
+                f"{num_steps}-step chunk)")
+        if fault.kind == "halo":
+            with st.halo_window(fault):
+                return self._run_inner(model, space, num_steps)
+        out = self._run_inner(model, space, num_steps)
+        return inject.poison_values(out, fault, st.plan)
+
+    def _run_inner(self, model, space: CellularSpace,
+                   num_steps: int) -> Values:
         _check_divisible(space, self.mesh)
         #: per-run report detail (Report.backend_report) — reset so a
         #: previous run's composed record never leaks forward
@@ -292,10 +319,14 @@ class ShardMapExecutor:
         # STEP COUNT is deliberately NOT part of it: runners take the
         # count as a traced scalar (dynamic trip count), so a supervisor
         # sweeping chunk sizes or a remainder chunk reuses one compile.
+        # the trailing inject.build_token() is None except while a halo
+        # fault is armed — a perturbed build lives under its own key and
+        # can never serve (or be served by) a clean chunk
         key = (space.shape, space.global_shape,
                (space.x_init, space.y_init), str(space.dtype),
                tuple(space.values), model.offsets,
-               tuple(f.fingerprint() for f in model.flows))
+               tuple(f.fingerprint() for f in model.flows),
+               inject.build_token())
         spec = grid_spec(self.mesh)
         sharding = NamedSharding(self.mesh, spec)
         put = partial(put_global, sharding=sharding)

@@ -58,6 +58,21 @@ def exchange_halo_1d(local: jax.Array, axis_name: str, axis_size: int,
     return before, after
 
 
+def _chaos_ring(padded: jax.Array, depth: int) -> jax.Array:
+    """Fault-injection seam (``resilience.inject``): while a halo fault
+    is armed, perturb the received ghost rows of a freshly padded shard
+    — the deterministic stand-in for a corrupted ppermute payload.
+    Consulted at TRACE time only; unarmed it returns its input
+    untouched, so the built jaxpr is identical to an uninstrumented one
+    (asserted in tests/test_chaos.py)."""
+    from ..resilience import inject
+
+    eps = inject.halo_perturbation()
+    if eps is None:
+        return padded
+    return padded.at[:depth, :].add(jnp.asarray(eps, padded.dtype))
+
+
 def pad_with_halo_1d(local: jax.Array, axis_name: str, axis_size: int,
                      depth: int = 1) -> jax.Array:
     """[h, w] shard → [h+2d, w+2d]: row slabs exchanged with mesh
@@ -65,7 +80,8 @@ def pad_with_halo_1d(local: jax.Array, axis_name: str, axis_size: int,
     before, after = exchange_halo_1d(local, axis_name, axis_size, axis=0,
                                      depth=depth)
     padded_rows = jnp.concatenate([before, local, after], axis=0)
-    return jnp.pad(padded_rows, ((0, 0), (depth, depth)))
+    return _chaos_ring(jnp.pad(padded_rows, ((0, 0), (depth, depth))),
+                       depth)
 
 
 def pad_with_halo_2d(local: jax.Array, ax_name: str, ay_name: str,
@@ -78,7 +94,8 @@ def pad_with_halo_2d(local: jax.Array, ax_name: str, ay_name: str,
     aug = jnp.concatenate([left, local, right], axis=1)          # [h, w+2d]
     top, bottom = exchange_halo_1d(aug, ax_name, nx, axis=0,     # [d, w+2d]
                                    depth=depth)
-    return jnp.concatenate([top, aug, bottom], axis=0)           # [h+2d, w+2d]
+    return _chaos_ring(
+        jnp.concatenate([top, aug, bottom], axis=0), depth)      # [h+2d, w+2d]
 
 
 def exchange_ring(local: jax.Array, ax_name: str, nx: int,

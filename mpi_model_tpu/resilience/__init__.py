@@ -1,0 +1,41 @@
+"""Resilience package: failure detection + recovery (``supervisor``)
+and deterministic fault injection (``inject``) — ISSUE 5 promoted the
+former ``resilience.py`` module to this package so the chaos harness
+and the self-healing policies it validates live side by side.
+
+Supervisor symbols are re-exported lazily (PEP 562): ``supervisor``
+imports ``models.model``, while ``models.model`` imports the
+dependency-free ``inject`` seams from THIS package — an eager
+``from .supervisor import *`` here would make that a cycle during
+package init. The public surface is unchanged:
+``from mpi_model_tpu.resilience import supervised_run`` etc. keep
+working exactly as before the promotion.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_SUPERVISOR_SYMBOLS = (
+    "HealthError",
+    "SimulationFailure",
+    "FailureEvent",
+    "SupervisedResult",
+    "check_health",
+    "supervised_run",
+)
+
+__all__ = list(_SUPERVISOR_SYMBOLS) + ["inject", "supervisor"]
+
+
+def __getattr__(name: str):
+    if name in _SUPERVISOR_SYMBOLS:
+        return getattr(importlib.import_module(".supervisor", __name__),
+                       name)
+    if name in ("inject", "supervisor"):
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(__all__)

@@ -1,0 +1,357 @@
+"""Deterministic fault injection — the chaos half of the resilience
+package (ISSUE 5 tentpole).
+
+The recovery machinery (``supervisor``, checkpoint voting, ensemble
+isolation) used to be exercised only by the faults nature happened to
+send; this module makes every hard path drivable on demand. A
+:class:`FaultPlan` is PURE DATA — a seed plus a tuple of :class:`Fault`
+records naming the seam, the firing index and the corruption parameters
+— so a chaos scenario is reproducible bit-for-bit: the same plan against
+the same run injects the same fault at the same place every time.
+
+Seams (each a module-level query the instrumented code calls):
+
+=============  ==============================================================
+site           where the seam lives / what the fault does
+=============  ==============================================================
+``executor``   ``SerialExecutor.run_model`` / ``ShardMapExecutor.run_model``
+               chunk boundaries — ``kind="exc"`` raises
+               :class:`InjectedFault`; ``kind="nan"`` writes NaN/Inf into a
+               channel cell of the chunk's OUTPUT; ``kind="halo"``
+               (sharded only) perturbs the ghost ring for that one chunk
+``checkpoint``  the ``io`` writers — ``kind="torn"`` truncates or corrupts
+               the just-written file at a byte offset (dense ``.npz``,
+               sharded shard file, or the sharded manifest)
+``ensemble``   ``run_ensemble`` — ``kind="lane_nan"`` poisons one scenario
+               lane's output (by lane index, or by ticket through the
+               scheduler's mapping; ``once=False`` makes it a sticky
+               SCENARIO fault that re-fires on the solo retry)
+``dispatch``   the ensemble scheduler — ``kind="batch_exc"`` fails one
+               whole dispatch; ``kind="hang"`` adds seconds to the
+               dispatch's injectable-clock duration so the deadline
+               policy sees a hang
+=============  ==============================================================
+
+Zero overhead when disarmed: every seam starts with one module-global
+read (``active() is None``) on the EAGER side of the jit boundary, and
+the only trace-time seam (the halo ring) returns its input untouched —
+the built jaxpr is identical to an uninstrumented build (asserted in
+``tests/test_chaos.py`` and by the ``analysis.jaxpr_audit`` goldens).
+
+This module imports nothing from the rest of the package (the seams
+live in modules the supervisor itself imports), so any layer can import
+it without cycles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from typing import Optional
+
+__all__ = [
+    "Fault",
+    "FaultPlan",
+    "ArmedPlan",
+    "InjectedFault",
+    "armed",
+    "active",
+    "halo_perturbation",
+    "build_token",
+    "poison_values",
+    "checkpoint_torn",
+    "tear_file",
+]
+
+
+class InjectedFault(RuntimeError):
+    """The exception an armed ``exc``/``batch_exc`` fault raises — a
+    distinct type so tests and supervisors can tell injected chaos from
+    a genuine failure leaking through the same path."""
+
+
+#: fault kind → seam site (one table, so a typo'd kind fails at plan
+#: construction instead of silently never firing)
+SITE_OF = {
+    "exc": "executor",
+    "nan": "executor",
+    "halo": "executor",
+    "torn": "checkpoint",
+    "lane_nan": "ensemble",
+    "batch_exc": "dispatch",
+    "hang": "dispatch",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One armed fault: WHERE (kind → seam site), WHEN (``at`` = the
+    seam's 0-based firing index: executor chunk, dispatch count, or —
+    for ``torn`` — the checkpoint STEP), and the corruption parameters.
+    ``once=True`` (default) consumes the fault after its first firing —
+    a TRANSIENT fault the recovery layer must heal; ``once=False`` keeps
+    it armed — a DETERMINISTIC fault (e.g. a poisoned scenario) the
+    layer must fail fast on / quarantine."""
+
+    kind: str
+    #: seam firing index (None = first opportunity); for "torn" this is
+    #: the checkpoint step being written
+    at: Optional[int] = None
+    #: channel to poison ("nan"/"lane_nan"; None → first channel)
+    channel: Optional[str] = None
+    #: cell to poison (None → (0, 0))
+    cell: Optional[tuple] = None
+    #: scenario lane to poison (direct run_ensemble use)
+    lane: Optional[int] = None
+    #: scheduler ticket whose lane to poison (the scheduler maps it)
+    ticket: Optional[int] = None
+    #: byte offset for "torn"
+    offset: int = 0
+    #: bytes corrupted at the offset ("torn", tear="corrupt")
+    nbytes: int = 64
+    #: "truncate" (tear the file AT offset) or "corrupt" (flip bytes)
+    tear: str = "corrupt"
+    #: injected hang duration ("hang"), in injectable-clock seconds
+    seconds: float = 0.0
+    #: poison / perturbation value (None → NaN for poisons, 1.0 for halo)
+    value: Optional[float] = None
+    once: bool = True
+
+    def __post_init__(self):
+        if self.kind not in SITE_OF:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} "
+                f"(expected one of {sorted(SITE_OF)})")
+        if self.tear not in ("truncate", "corrupt"):
+            raise ValueError(f"unknown tear mode {self.tear!r}")
+
+    @property
+    def site(self) -> str:
+        return SITE_OF[self.kind]
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A reproducible chaos scenario: pure data, safe to log/serialize.
+    ``seed`` feeds the derived perturbation values (``value_for``) so an
+    unpinned fault still corrupts deterministically."""
+
+    faults: tuple
+    seed: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "faults", tuple(self.faults))
+
+    def value_for(self, index: int) -> float:
+        """Deterministic perturbation magnitude for fault ``index`` when
+        its ``value`` is unpinned: drawn from a generator seeded by
+        ``(seed, index)`` — stable across runs and platforms."""
+        import numpy as np
+
+        return float(np.random.default_rng((self.seed, index))
+                     .uniform(1.0, 2.0))
+
+
+class ArmedPlan:
+    """Runtime state of one armed plan: per-site firing counters, the
+    consumed-fault set, and the observable ``fired`` log (what actually
+    went off, in order — chaos tests assert completeness against it)."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._counters: dict = {}
+        self._consumed: set = set()
+        #: [{"index", "site", "kind", "at"}] — every firing, in order
+        self.fired: list = []
+        #: trace-time halo perturbation (set only inside halo_window)
+        self.halo_eps: Optional[float] = None
+        #: (lane, Fault) poisons the scheduler pushed for the CURRENT
+        #: physical dispatch (ticket → lane mapping is the scheduler's)
+        self._lane_poisons: list = []
+
+    def bump(self, site: str) -> int:
+        """Advance and return ``site``'s firing index (counts every
+        seam visit — retries included — so ``at`` is deterministic)."""
+        idx = self._counters.get(site, 0)
+        self._counters[site] = idx + 1
+        return idx
+
+    def take(self, site: str, index: Optional[int] = None,
+             kinds: Optional[tuple] = None) -> Optional[Fault]:
+        """First live fault matching (site, index, kinds); consumes it
+        when ``once``. ``index=None`` matches only index-unpinned
+        faults."""
+        for i, f in enumerate(self.plan.faults):
+            if f.site != site or (kinds is not None and f.kind not in kinds):
+                continue
+            if i in self._consumed:
+                continue
+            if f.at is not None and f.at != index:
+                continue
+            if f.ticket is not None:
+                continue  # ticket faults fire via ticket_fault only
+            self._fire(i, f)
+            return f
+        return None
+
+    def ticket_fault(self, ticket) -> Optional[Fault]:
+        """Live ``lane_nan`` fault bound to ``ticket`` (the scheduler's
+        per-dispatch lane mapping); consumed per its ``once``."""
+        for i, f in enumerate(self.plan.faults):
+            if (f.kind == "lane_nan" and f.ticket == ticket
+                    and i not in self._consumed):
+                self._fire(i, f)
+                return f
+        return None
+
+    def _fire(self, i: int, f: Fault) -> None:
+        if f.once:
+            self._consumed.add(i)
+        self.fired.append({"index": i, "site": f.site, "kind": f.kind,
+                           "at": f.at})
+
+    # -- halo window (trace-time seam, chunk-scoped) -----------------------
+
+    @contextlib.contextmanager
+    def halo_window(self, fault: Fault):
+        """Arm the trace-time halo perturbation for the duration of ONE
+        executor chunk; pad_with_halo_* read it while tracing."""
+        idx = self.plan.faults.index(fault)
+        self.halo_eps = (fault.value if fault.value is not None
+                         else self.plan.value_for(idx))
+        try:
+            yield
+        finally:
+            self.halo_eps = None
+
+    # -- ensemble lane poisons (scheduler ticket → lane mapping) -----------
+
+    def push_lane_poisons(self, poisons: list) -> None:
+        self._lane_poisons = list(poisons)
+
+    def clear_lane_poisons(self) -> None:
+        self._lane_poisons = []
+
+    def ensemble_poisons(self, index: int) -> list:
+        """(lane, Fault) pairs to poison in this ``run_ensemble`` call:
+        scheduler-pushed ticket poisons plus any direct lane faults
+        matching the ensemble-site firing index."""
+        out = list(self._lane_poisons)
+        for i, f in enumerate(self.plan.faults):
+            if (f.kind == "lane_nan" and f.ticket is None
+                    and f.lane is not None and i not in self._consumed
+                    and (f.at is None or f.at == index)):
+                self._fire(i, f)
+                out.append((f.lane, f))
+        return out
+
+
+_ACTIVE: Optional[ArmedPlan] = None
+
+
+def active() -> Optional[ArmedPlan]:
+    """The armed plan's runtime state, or None — THE fast path every
+    seam checks first (one global read when chaos is off)."""
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def armed(plan: FaultPlan):
+    """Arm ``plan`` for the duration of the block (one plan at a time —
+    overlapping chaos scenarios would not be reproducible)."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("a FaultPlan is already armed")
+    st = ArmedPlan(plan)
+    _ACTIVE = st
+    try:
+        yield st
+    finally:
+        _ACTIVE = None
+
+
+# -- seam helpers (called by the instrumented modules) ------------------------
+
+def halo_perturbation() -> Optional[float]:
+    """Trace-time ghost-ring perturbation, or None (the unarmed value —
+    the pad functions return their input untouched, identical jaxpr)."""
+    st = _ACTIVE
+    return None if st is None else st.halo_eps
+
+
+def build_token():
+    """Runner-cache key component: non-None only while a halo fault is
+    armed, so a perturbed build never poisons the clean runner cache
+    (and the clean cache key is byte-identical to the uninstrumented
+    one's shape)."""
+    st = _ACTIVE
+    if st is None or st.halo_eps is None:
+        return None
+    return ("chaos-halo", st.halo_eps)
+
+
+def poison_values(values: dict, fault: Fault, plan: FaultPlan) -> dict:
+    """Host-side state poison: NaN (or ``fault.value``) written into one
+    cell of one channel of an executor chunk's OUTPUT values."""
+    import jax.numpy as jnp
+
+    ch = fault.channel if fault.channel is not None else next(iter(values))
+    x, y = fault.cell if fault.cell is not None else (0, 0)
+    v = values[ch]
+    bad = jnp.asarray(float("nan") if fault.value is None else fault.value,
+                      v.dtype)
+    return {**values, ch: v.at[x, y].set(bad)}
+
+
+def poison_lane_values(values_b: dict, lane: int, fault: Fault) -> dict:
+    """Lane poison for the ensemble engine: NaN into one cell of one
+    channel of scenario ``lane``'s output."""
+    import jax.numpy as jnp
+
+    ch = fault.channel if fault.channel is not None else next(iter(values_b))
+    x, y = fault.cell if fault.cell is not None else (0, 0)
+    v = values_b[ch]
+    bad = jnp.asarray(float("nan") if fault.value is None else fault.value,
+                      v.dtype)
+    return {**values_b, ch: v.at[lane, x, y].set(bad)}
+
+
+def checkpoint_torn(path: str, step: int, part: str = "data") -> None:
+    """Checkpoint-writer seam: tear/corrupt the just-written file when a
+    ``torn`` fault is armed for this step. ``part`` distinguishes the
+    sharded format's shard files ("data") from its manifest — a fault
+    pins the part via its ``channel`` field ("manifest" to tear the
+    commit record itself)."""
+    st = _ACTIVE
+    if st is None:
+        return
+    for i, f in enumerate(st.plan.faults):
+        if f.kind != "torn" or i in st._consumed:
+            continue
+        if f.at is not None and f.at != step:
+            continue
+        want_part = f.channel or "data"
+        if want_part != part:
+            continue
+        st._fire(i, f)
+        tear_file(path, f.offset, f.nbytes, f.tear)
+        return
+
+
+def tear_file(path: str, offset: int = 0, nbytes: int = 64,
+              tear: str = "corrupt") -> None:
+    """Deterministically damage ``path``: ``truncate`` cuts the file at
+    ``offset`` (a write torn mid-flight); ``corrupt`` flips ``nbytes``
+    bytes starting there (bit rot the checksums must catch)."""
+    size = os.path.getsize(path)
+    if tear == "truncate":
+        with open(path, "r+b") as fh:
+            fh.truncate(min(offset, size))
+        return
+    off = min(offset, max(size - 1, 0))
+    with open(path, "r+b") as fh:
+        fh.seek(off)
+        data = fh.read(nbytes)
+        fh.seek(off)
+        fh.write(bytes(b ^ 0xFF for b in data))

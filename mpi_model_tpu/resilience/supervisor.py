@@ -36,9 +36,9 @@ import dataclasses
 import time as _time
 from typing import Callable, Optional
 
-from .core.cellular_space import CellularSpace
-from .io.checkpoint import CheckpointManager
-from .models.model import Model, Report
+from ..core.cellular_space import CellularSpace
+from ..io.checkpoint import CheckpointManager
+from ..models.model import Model, Report
 
 __all__ = [
     "HealthError",
@@ -70,11 +70,15 @@ class SimulationFailure(RuntimeError):
 
 @dataclasses.dataclass
 class FailureEvent:
-    """One detected failure and what the supervisor did about it."""
+    """One detected failure and what the recovery layer did about it —
+    emitted by the supervisor (rollback/retry) and by the ensemble
+    scheduler (quarantine), so one record type feeds tracing, metrics
+    and post-mortems everywhere."""
 
     #: step the failed chunk would have reached
     step: int
     #: "exception" (executor raised) | "nonfinite" | "conservation"
+    #: | "timeout" (a dispatch overran its deadline)
     kind: str
     detail: str
     #: step rolled back to (== step of the last good checkpoint)
@@ -82,6 +86,16 @@ class FailureEvent:
     #: consecutive-failure count at the time (1 = first)
     attempt: int
     wall_time_s: float
+    #: "transient" (retried) or "deterministic" (the SAME fault recurred
+    #: identically after rollback — the supervisor fails fast instead of
+    #: burning max_failures recomputing a poisoned chunk; for the
+    #: scheduler, a scenario whose solo retry failed too)
+    classification: str = "transient"
+    #: backoff slept before the retry this event triggered (0 = none)
+    backoff_s: float = 0.0
+    #: the scheduler ticket this event quarantined (None for supervisor
+    #: events — tickets are a serving-layer concept)
+    ticket: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -113,19 +127,22 @@ def check_health(space: CellularSpace,
     when ``initial_totals``/``threshold`` are given — total-mass drift
     beyond the conservation contract. All checks are device-side
     reductions (one ``isfinite().all()``, one ``sum`` per channel,
-    accumulated in f32-or-wider); only scalars cross to the host, so the
-    check is cheap even at 1e8 cells and on sharded arrays (the sums
-    lower to ICI all-reduces)."""
+    accumulated in f32-or-wider); every resulting scalar is fetched in
+    ONE ``jax.device_get`` — the check costs one host sync regardless
+    of channel count (a per-channel ``bool()`` loop would serialize a
+    round-trip per channel), so it stays cheap even at 1e8 cells and on
+    sharded arrays (the sums lower to ICI all-reduces)."""
+    import jax
     import jax.numpy as jnp
 
     problems: list[str] = []
-    checks = []
+    names, scalars = [], []
     for name, arr in space.values.items():
         acc = jnp.promote_types(arr.dtype, jnp.float32)
-        checks.append((name,
-                       jnp.isfinite(arr).all(),
-                       jnp.sum(arr, dtype=acc)))
-    for name, finite, total in checks:  # device work above, sync here
+        names.append(name)
+        scalars.append((jnp.isfinite(arr).all(), jnp.sum(arr, dtype=acc)))
+    fetched = jax.device_get(scalars)  # device work above, ONE sync here
+    for name, (finite, total) in zip(names, fetched):
         if not bool(finite):
             problems.append(
                 f"channel {name!r}: non-finite cell(s) "
@@ -167,6 +184,10 @@ def supervised_run(
     tolerance: float = 1e-3,
     rtol: Optional[float] = None,
     on_event: Optional[Callable[[FailureEvent], None]] = None,
+    retry_backoff_s: float = 0.0,
+    backoff_jitter: float = 0.5,
+    backoff_seed: int = 0,
+    fail_fast_deterministic: bool = True,
 ) -> SupervisedResult:
     """Run ``model`` for ``steps`` under failure supervision.
 
@@ -187,6 +208,21 @@ def supervised_run(
     (wire it to logging/metrics). ``health_checks=False`` disables the
     in-band state checks (executor exceptions are still supervised) —
     ``io.run_checkpointed`` is this function with ``max_failures=0``.
+
+    ``retry_backoff_s > 0`` sleeps before each retry — exponential in
+    the consecutive-failure count with a JITTERED factor drawn from a
+    generator seeded by ``backoff_seed`` (deterministic per run, but
+    decorrelated across a fleet of restarting supervisors hammering a
+    shared filesystem/coordinator). The slept duration is recorded on
+    the event (``FailureEvent.backoff_s``).
+
+    ``fail_fast_deterministic`` (default on) classifies each failure
+    against the previous one: when the SAME fault (kind, step, detail)
+    recurs immediately after a rollback, the fault is deterministic —
+    recomputing the chunk can only reproduce it — so the supervisor
+    raises ``SimulationFailure`` at once instead of burning
+    ``max_failures`` retries on a poisoned chunk. The classification
+    rides each event (``FailureEvent.classification``).
     """
     total = model.num_steps if steps is None else int(steps)
     if every <= 0:
@@ -227,7 +263,7 @@ def supervised_run(
         manager.save(good_space, good_step,
                      extra={"initial_totals": initial})
 
-    from .utils.tracing import get_tracer
+    from ..utils.tracing import get_tracer
 
     tracer = get_tracer()
     events: list[FailureEvent] = []
@@ -240,7 +276,9 @@ def supervised_run(
         return _supervise_loop(
             model, space, manager, total, every, max_failures, executor,
             health_checks, threshold, initial, good_space, good_step,
-            tracer, events, on_event)
+            tracer, events, on_event,
+            _RetryPolicy(retry_backoff_s, backoff_jitter, backoff_seed,
+                         fail_fast_deterministic))
     except BaseException:
         run_raising = True
         raise
@@ -263,12 +301,41 @@ def supervised_run(
                 tracer.instant("supervise.flush_failed")
 
 
+@dataclasses.dataclass(frozen=True)
+class _RetryPolicy:
+    """The supervisor's between-retry knobs, bundled so the loop keeps
+    a readable signature."""
+
+    backoff_s: float
+    jitter: float
+    seed: int
+    fail_fast: bool
+
+    def delay(self, rng, attempt: int) -> float:
+        """Jittered exponential backoff for consecutive failure
+        ``attempt`` (1-based); 0.0 when backoff is off."""
+        if self.backoff_s <= 0.0:
+            return 0.0
+        return (self.backoff_s * (2.0 ** (attempt - 1))
+                * (1.0 + self.jitter * float(rng.random())))
+
+
 def _supervise_loop(model, space, manager, total, every, max_failures,
                     executor, health_checks, threshold, initial,
-                    good_space, good_step, tracer, events, on_event
-                    ) -> SupervisedResult:
+                    good_space, good_step, tracer, events, on_event,
+                    retry: _RetryPolicy) -> SupervisedResult:
     consecutive = 0
     report: Optional[Report] = None
+    # seeded ONCE per run: backoff jitter is reproducible given the seed
+    # yet still decorrelates a fleet (different seeds per process)
+    backoff_rng = None
+    if retry.backoff_s > 0.0:
+        import numpy as np
+
+        backoff_rng = np.random.default_rng(retry.seed)
+    #: (kind, step, detail) of the previous failure — an identical
+    #: consecutive signature means the fault is deterministic
+    last_sig = None
     while good_step < total:
         n = min(every, total - good_step)
         t0 = _time.perf_counter()
@@ -288,31 +355,55 @@ def _supervise_loop(model, space, manager, total, every, max_failures,
         # max_failures exhaustion re-raises
         except Exception as exc:  # noqa: BLE001 — supervisor boundary
             consecutive += 1
+            detail = f"{type(exc).__name__}: {exc}"
+            sig = (_classify(exc), good_step + n, detail)
+            deterministic = retry.fail_fast and sig == last_sig
+            last_sig = sig
+            exhausted = consecutive > max_failures
+            backoff = (0.0 if deterministic or exhausted
+                       else retry.delay(backoff_rng, consecutive))
             ev = FailureEvent(
                 step=good_step + n,
-                kind=_classify(exc),
-                detail=f"{type(exc).__name__}: {exc}",
+                kind=sig[0],
+                detail=detail,
                 rolled_back_to=good_step,
                 attempt=consecutive,
                 wall_time_s=_time.perf_counter() - t0,
+                classification=("deterministic" if deterministic
+                                else "transient"),
+                backoff_s=backoff,
             )
             events.append(ev)
             tracer.instant("supervise.failure", kind=ev.kind,
                            step=ev.step, attempt=ev.attempt,
                            detail=ev.detail,
-                           rolled_back_to=ev.rolled_back_to)
+                           rolled_back_to=ev.rolled_back_to,
+                           classification=ev.classification)
             if on_event is not None:
                 on_event(ev)
-            if consecutive > max_failures:
+            if deterministic:
+                # the same fault at the same step with the same detail,
+                # straight after a rollback: recomputing the chunk can
+                # only reproduce it — fail fast instead of burning the
+                # remaining max_failures budget on a poisoned chunk
+                raise SimulationFailure(
+                    f"deterministic failure at step {good_step + n}: the "
+                    "same fault recurred identically after rollback "
+                    f"(failing fast; max_failures={max_failures} "
+                    f"unspent); last: {ev.detail}", events) from exc
+            if exhausted:
                 raise SimulationFailure(
                     f"{consecutive} consecutive failures at step "
                     f"{good_step + n} (max_failures={max_failures}); "
                     f"last: {ev.detail}", events) from exc
+            if backoff > 0.0:
+                _time.sleep(backoff)
             # roll back: re-run the chunk from the last good state (the
             # in-memory copy; the manager holds the same state durably)
             continue
 
         consecutive = 0
+        last_sig = None
         good_space, good_step = out_space, good_step + n
         if manager is not None:
             manager.save(good_space, good_step,

@@ -204,6 +204,14 @@ class ThroughputCounter:
     compute), ``batch_occupancy`` (real lanes / dispatched lanes — how
     much of each padded bucket did real work) and
     ``compile_cache_hit_rate`` (dispatches that reused a built runner).
+
+    The self-healing counters (ISSUE 5) make recovery observable, never
+    silent: ``solo_retries`` (failed scenarios re-dispatched alone),
+    ``recovered_failures`` (scenarios whose solo retry succeeded — the
+    fault was the batch's, not theirs), ``quarantined`` (scenarios whose
+    solo retry failed too — deterministic scenario faults, isolated with
+    their ``FailureEvent``) and ``impl_faults`` (whole-dispatch failures
+    feeding the degradation ladder).
     """
 
     def __init__(self):
@@ -212,6 +220,10 @@ class ThroughputCounter:
         self.lanes = 0
         self.busy_s = 0.0
         self.cache_hits = 0
+        self.solo_retries = 0
+        self.recovered_failures = 0
+        self.quarantined = 0
+        self.impl_faults = 0
 
     def record_dispatch(self, scenarios: int, bucket: int, wall_s: float,
                         cache_hit: bool) -> None:
@@ -234,6 +246,10 @@ class ThroughputCounter:
             "compile_cache_hit_rate": (self.cache_hits / self.dispatches
                                        if self.dispatches else None),
             "busy_s": self.busy_s,
+            "solo_retries": self.solo_retries,
+            "recovered_failures": self.recovered_failures,
+            "quarantined": self.quarantined,
+            "impl_faults": self.impl_faults,
         }
 
 

@@ -434,6 +434,59 @@ def test_jaxpr_audit_ensemble_golden():
 
 # -- the repo gate ------------------------------------------------------------
 
+# -- naked-save (ISSUE 5: unverifiable-checkpoint guard) ----------------------
+
+def test_naked_save_positive():
+    # raw writer call and manager-ish .save outside the boundaries
+    src = ("from mpi_model_tpu.io import save_checkpoint\n"
+           "def f(space, mgr):\n"
+           "    save_checkpoint('x.npz', space, 3)\n"
+           "    mgr.save(space, 3)\n")
+    assert rules_of(lint_source(src, PKG)) == ["naked-save", "naked-save"]
+    # the sharded writers are equally raw
+    src2 = ("def g(space):\n"
+            "    stage_checkpoint_sharded('d.ckpt', space, 3)\n")
+    assert rules_of(lint_source(src2, PKG)) == ["naked-save"]
+    # a manager stored on an attribute chain must not bypass the rule
+    src3 = ("class S:\n"
+            "    def f(self, space):\n"
+            "        self.mgr.save(space, 3)\n"
+            "        self.cfg.manager.save(space, 3)\n")
+    assert rules_of(lint_source(src3, PKG)) == ["naked-save", "naked-save"]
+
+
+def test_naked_save_allowed_at_the_boundaries():
+    src = ("def f(space, mgr):\n"
+           "    save_checkpoint('x.npz', space, 3)\n"
+           "    mgr.save(space, 3)\n")
+    # the io writers themselves and the resilience package own the
+    # supervisor/flush boundaries
+    for path in ("mpi_model_tpu/io/checkpoint.py",
+                 "mpi_model_tpu/io/sharded.py",
+                 "mpi_model_tpu/resilience/supervisor.py"):
+        assert rules_of(lint_source(src, path)) == []
+
+
+def test_naked_save_negative_non_checkpoint_saves():
+    # unrelated .save receivers and np.savez are not checkpoint writes;
+    # tests are out of scope entirely (SCOPE_PACKAGE)
+    src = ("def f(fig, arr):\n"
+           "    fig.save('plot.png')\n"
+           "    np.savez('data.npz', arr=arr)\n")
+    assert rules_of(lint_source(src, PKG)) == []
+    src2 = ("def f(mgr, space):\n"
+            "    mgr.save(space, 3)\n")
+    assert rules_of(lint_source(src2, "tests/test_fake.py")) == []
+
+
+def test_naked_save_pragma_suppresses_with_reason():
+    src = ("def f(mgr, space):\n"
+           "    # analysis: ignore[naked-save] — bootstrap write before\n"
+           "    # the supervisor exists\n"
+           "    mgr.save(space, 0)\n")
+    assert rules_of(lint_source(src, PKG)) == []
+
+
 def test_repo_is_clean_under_strict_analysis():
     """THE gate (ISSUE 4 acceptance): zero unsuppressed findings of any
     severity over the whole tree, every suppression carries a reason,

@@ -1,0 +1,590 @@
+"""Chaos matrix (ISSUE 5): every injected fault kind through every
+recovery path, asserting the invariants that define this repo — state
+after recovery BITWISE equal to an uninterrupted run, conservation
+intact, event logs complete, corrupt-latest resume landing on the prior
+verified checkpoint — or, for deterministic faults, the documented
+fail-fast / quarantine outcome with a complete ``FailureEvent``.
+
+All faults here are in-memory / on-local-disk (no subprocesses), so the
+matrix runs inside the tier-1 inner loop as the chaos smoke."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_model_tpu import CellularSpace, Diffusion, Model
+from mpi_model_tpu.ensemble import (DispatchTimeout, EnsembleScheduler,
+                                    run_ensemble)
+from mpi_model_tpu.io import CheckpointManager
+from mpi_model_tpu.io.checkpoint import (CheckpointCorruptionError,
+                                         load_checkpoint, save_checkpoint)
+from mpi_model_tpu.models.model import SerialExecutor
+from mpi_model_tpu.resilience import (SimulationFailure, inject,
+                                      supervised_run)
+from mpi_model_tpu.resilience.inject import Fault, FaultPlan, InjectedFault
+
+RNG = np.random.default_rng(11)
+RNG_BASE = RNG.uniform(0.5, 2.0, (16, 16))
+
+
+def make_space(h=12, w=16, seed_roll=0):
+    vals = jnp.asarray(np.roll(RNG_BASE, seed_roll, axis=0)[:h, :w],
+                       dtype=jnp.float64)
+    return CellularSpace.create(h, w, 1.0, dtype=jnp.float64).with_values(
+        {"value": vals})
+
+
+def make_model(time=8.0):
+    return Model(Diffusion(0.1), time=time, time_step=1.0)
+
+
+def expected_final(model, space, steps=8, executor=None):
+    out, _ = model.execute(space, executor, steps=steps)
+    return np.asarray(out.values["value"])
+
+
+# -- the plan is pure data ----------------------------------------------------
+
+def test_fault_plan_is_pure_data_and_seeded():
+    plan = FaultPlan((Fault("exc", at=2), Fault("halo")), seed=9)
+    # frozen dataclasses: a plan cannot mutate under an armed run
+    with pytest.raises(Exception):
+        plan.faults[0].at = 3
+    # derived values are deterministic per (seed, index)
+    assert plan.value_for(1) == FaultPlan(plan.faults, seed=9).value_for(1)
+    assert plan.value_for(1) != FaultPlan(plan.faults, seed=10).value_for(1)
+
+
+def test_unknown_fault_kind_rejected():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        Fault("meteor")
+    with pytest.raises(ValueError, match="tear mode"):
+        Fault("torn", tear="gnaw")
+
+
+def test_armed_is_exclusive_and_clears():
+    plan = FaultPlan((Fault("exc"),))
+    with inject.armed(plan):
+        assert inject.active() is not None
+        with pytest.raises(RuntimeError, match="already armed"):
+            with inject.armed(plan):
+                pass
+    assert inject.active() is None
+
+
+# -- executor faults heal bitwise (supervisor path) ---------------------------
+
+def test_injected_executor_exception_recovers_bitwise():
+    space, model = make_space(), make_model()
+    want = expected_final(model, space)
+    plan = FaultPlan((Fault("exc", at=1),))
+    with inject.armed(plan) as st:
+        res = supervised_run(model, space, steps=8, every=2,
+                             executor=SerialExecutor())
+    assert [f["kind"] for f in st.fired] == ["exc"]
+    (ev,) = res.events
+    assert ev.kind == "exception" and "InjectedFault" in ev.detail
+    assert ev.classification == "transient"
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]), want)  # bit-identical
+
+
+def test_injected_nan_state_recovers_bitwise():
+    space, model = make_space(), make_model()
+    want = expected_final(model, space)
+    plan = FaultPlan((Fault("nan", at=1, cell=(3, 4)),))
+    with inject.armed(plan) as st:
+        res = supervised_run(model, space, steps=8, every=2,
+                             executor=SerialExecutor())
+    assert [f["kind"] for f in st.fired] == ["nan"]
+    (ev,) = res.events
+    assert ev.kind == "nonfinite"
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]), want)
+
+
+def test_halo_perturbation_detected_and_recovered_bitwise():
+    from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh
+
+    space, model = make_space(16, 16), make_model()
+    want = expected_final(model, space, executor=ShardMapExecutor(
+        make_mesh(4)))
+    ex = ShardMapExecutor(make_mesh(4))
+    plan = FaultPlan((Fault("halo", at=1),), seed=7)
+    with inject.armed(plan) as st:
+        res = supervised_run(model, space, steps=8, every=2, executor=ex)
+    assert [f["kind"] for f in st.fired] == ["halo"]
+    (ev,) = res.events
+    # a perturbed ghost payload manufactures mass: the in-band
+    # conservation check is the detector
+    assert ev.kind == "conservation"
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]), want)
+
+
+# -- transient vs deterministic classification + backoff ----------------------
+
+class _SameFaultExecutor:
+    """Raises the IDENTICAL error on chosen calls — the deterministic
+    signature (same kind, step, detail twice in a row)."""
+
+    comm_size = 1
+
+    def __init__(self, fail_calls):
+        self.fail_calls = set(fail_calls)
+        self.calls = 0
+        self._inner = SerialExecutor()
+
+    def run_model(self, model, space, num_steps):
+        idx = self.calls
+        self.calls += 1
+        if idx in self.fail_calls:
+            raise RuntimeError("poisoned chunk")  # identical every time
+        return self._inner.run_model(model, space, num_steps)
+
+
+def test_deterministic_fault_fails_fast():
+    space, model = make_space(), make_model()
+    ex = _SameFaultExecutor(fail_calls=set(range(100)))
+    with pytest.raises(SimulationFailure, match="deterministic"):
+        supervised_run(model, space, steps=8, every=2, executor=ex,
+                       max_failures=5)
+    # the budget was NOT burned: 2 attempts (first transient, identical
+    # recurrence classified deterministic), not max_failures+1
+    assert ex.calls == 2
+
+
+def test_deterministic_fail_fast_can_be_disabled():
+    space, model = make_space(), make_model()
+    ex = _SameFaultExecutor(fail_calls=set(range(100)))
+    with pytest.raises(SimulationFailure) as ei:
+        supervised_run(model, space, steps=8, every=2, executor=ex,
+                       max_failures=3, fail_fast_deterministic=False)
+    assert len(ei.value.events) == 4  # the old burn-the-budget behavior
+
+
+def test_varying_details_stay_transient():
+    space, model = make_space(), make_model()
+    want = expected_final(model, space, steps=4)
+    plan = FaultPlan((Fault("exc", at=0), Fault("exc", at=1)))
+    with inject.armed(plan):
+        res = supervised_run(model, space, steps=4, every=2,
+                             executor=SerialExecutor(), max_failures=3)
+    assert [e.classification for e in res.events] == ["transient"] * 2
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]), want)
+
+
+def test_backoff_is_jittered_and_reproducible():
+    space, model = make_space(), make_model()
+
+    def run():
+        plan = FaultPlan((Fault("exc", at=0), Fault("exc", at=2)))
+        with inject.armed(plan):
+            return supervised_run(
+                model, make_space(), steps=8, every=2,
+                executor=SerialExecutor(), retry_backoff_s=1e-4,
+                backoff_jitter=0.5, backoff_seed=13)
+
+    a, b = run(), run()
+    assert all(e.backoff_s > 0.0 for e in a.events)
+    # seeded jitter: the same seed reproduces the same delays
+    assert [e.backoff_s for e in a.events] == [e.backoff_s for e in b.events]
+
+
+# -- checkpoint integrity: torn writes, verified fallback ---------------------
+
+def test_checksums_written_and_roundtrip(tmp_path):
+    space = make_space()
+    path = save_checkpoint(str(tmp_path / "c.npz"), space, step=3)
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+    assert all("crc32" in ch for ch in meta["channels"].values())
+    ck = load_checkpoint(path)
+    np.testing.assert_array_equal(np.asarray(ck.space.values["value"]),
+                                  np.asarray(space.values["value"]))
+
+
+def test_corrupt_dense_checkpoint_fails_crc(tmp_path):
+    space = make_space()
+    path = save_checkpoint(str(tmp_path / "c.npz"), space, step=3)
+    # flip bytes in the middle of the channel payload (past the zip
+    # member header, before the meta member)
+    inject.tear_file(path, offset=300, nbytes=16, tear="corrupt")
+    # the zip layer's member CRC or this format's per-channel CRC32 —
+    # whichever catches it first, it must surface as corruption
+    with pytest.raises(CheckpointCorruptionError, match="CRC"):
+        load_checkpoint(path)
+
+
+def test_torn_dense_checkpoint_resume_falls_back(tmp_path):
+    """The acceptance invariant: corrupt-latest resume lands on the
+    newest VERIFIED checkpoint and the run completes bitwise."""
+    space, model = make_space(), make_model()
+    want = expected_final(model, space)
+    mgr = CheckpointManager(str(tmp_path), keep=10)
+    plan = FaultPlan((Fault("torn", at=8, tear="truncate", offset=128),))
+    with inject.armed(plan) as st:
+        supervised_run(model, space, mgr, steps=8, every=2,
+                       executor=SerialExecutor())
+    assert [f["kind"] for f in st.fired] == ["torn"]  # step 8 is torn
+
+    mgr2 = CheckpointManager(str(tmp_path), keep=10)
+    with pytest.warns(RuntimeWarning, match="failed verification"):
+        res = supervised_run(model, make_space(), mgr2, steps=8, every=2,
+                             executor=SerialExecutor())
+    assert res.step == 8
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]), want)
+
+
+def test_torn_sharded_checkpoint_resume_falls_back(tmp_path):
+    space, model = make_space(), make_model()
+    want = expected_final(model, space)
+    mgr = CheckpointManager(str(tmp_path), keep=10, layout="sharded")
+    plan = FaultPlan((Fault("torn", at=8, tear="truncate", offset=100),))
+    with inject.armed(plan) as st:
+        supervised_run(model, space, mgr, steps=8, every=2,
+                       executor=SerialExecutor())
+    assert [f["kind"] for f in st.fired] == ["torn"]
+
+    mgr2 = CheckpointManager(str(tmp_path), keep=10, layout="sharded")
+    with pytest.warns(RuntimeWarning, match="failed verification"):
+        res = supervised_run(model, make_space(), mgr2, steps=8, every=2,
+                             executor=SerialExecutor())
+    assert res.step == 8
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]), want)
+
+
+def test_torn_sharded_manifest_falls_back(tmp_path):
+    space, model = make_space(), make_model()
+    want = expected_final(model, space)
+    mgr = CheckpointManager(str(tmp_path), keep=10, layout="sharded")
+    plan = FaultPlan((Fault("torn", at=8, channel="manifest",
+                            tear="corrupt", offset=4),))
+    with inject.armed(plan):
+        supervised_run(model, space, mgr, steps=8, every=2,
+                       executor=SerialExecutor())
+    mgr2 = CheckpointManager(str(tmp_path), keep=10, layout="sharded")
+    with pytest.warns(RuntimeWarning, match="failed verification"):
+        res = supervised_run(model, make_space(), mgr2, steps=8, every=2,
+                             executor=SerialExecutor())
+    assert res.step == 8
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]), want)
+
+
+def test_all_checkpoints_corrupt_raises(tmp_path):
+    """Resuming from NOTHING when durable history exists-but-fails must
+    be an error, not a silent fresh start."""
+    space = make_space()
+    mgr = CheckpointManager(str(tmp_path), keep=10)
+    for step in (2, 4):
+        inject.tear_file(mgr.save(space, step), offset=0,
+                         tear="truncate")
+    with pytest.warns(RuntimeWarning, match="failed verification"):
+        with pytest.raises(CheckpointCorruptionError,
+                           match="no verifiable checkpoint"):
+            mgr.latest()
+
+
+def test_explicit_restore_of_corrupt_step_propagates(tmp_path):
+    space = make_space()
+    mgr = CheckpointManager(str(tmp_path), keep=10)
+    mgr.save(space, 2)
+    inject.tear_file(mgr.save(space, 4), offset=0, tear="truncate")
+    # latest() falls back; restore(step) is explicit and must not
+    with pytest.warns(RuntimeWarning):
+        assert mgr.latest().step == 2
+    with pytest.raises(CheckpointCorruptionError):
+        mgr.restore(4)
+
+
+# -- resume-time edge cases (ISSUE 5 satellite) -------------------------------
+
+def test_latest_on_husk_only_directory_is_none(tmp_path):
+    """A manifest-less .ckpt dir (crashed mid-vote) is not a checkpoint:
+    latest() reports an empty directory and a supervised run starts
+    fresh instead of dying on the husk."""
+    (tmp_path / "ckpt_0000000004.ckpt").mkdir()
+    mgr = CheckpointManager(str(tmp_path), layout="sharded")
+    assert mgr.latest() is None
+    space, model = make_space(), make_model()
+    res = supervised_run(model, space, mgr, steps=4, every=2,
+                         executor=SerialExecutor())
+    assert res.step == 4
+
+
+def test_resume_checkpoint_without_initial_totals(tmp_path):
+    """A checkpoint whose extra lacks initial_totals (written by an
+    older tool or by hand) must resume with a RECOMPUTED baseline, not
+    KeyError."""
+    space, model = make_space(), make_model()
+    mid, _ = model.execute(space, steps=4)
+    mgr = CheckpointManager(str(tmp_path), keep=10)
+    save_checkpoint(mgr.path_for(4), mid, step=4, extra={})
+    res = supervised_run(model, make_space(), mgr, steps=8, every=2,
+                         executor=SerialExecutor())
+    assert res.step == 8
+    assert set(res.initial_totals) == {"value"}
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]),
+        expected_final(model, mid, steps=4))
+
+
+def test_max_failures_zero_matches_run_checkpointed(tmp_path):
+    """supervised_run(max_failures=0) and io.run_checkpointed are the
+    same driver: identical results on a clean run, identical underlying
+    failure on a faulty one (modulo the documented wrapper)."""
+    from mpi_model_tpu.io import run_checkpointed
+
+    space, model = make_space(), make_model()
+    res = supervised_run(model, space, CheckpointManager(
+        str(tmp_path / "a"), keep=10), steps=8, every=3, max_failures=0,
+        executor=SerialExecutor())
+    out, step, _ = run_checkpointed(
+        model, make_space(), CheckpointManager(str(tmp_path / "b"),
+                                               keep=10),
+        steps=8, every=3, executor=SerialExecutor())
+    assert res.step == step == 8
+    np.testing.assert_array_equal(np.asarray(res.space.values["value"]),
+                                  np.asarray(out.values["value"]))
+    assert (CheckpointManager(str(tmp_path / "a")).steps()
+            == CheckpointManager(str(tmp_path / "b")).steps())
+
+    plan = FaultPlan((Fault("exc", at=0),))
+    with inject.armed(plan):
+        with pytest.raises(SimulationFailure) as ei:
+            supervised_run(model, make_space(), steps=4, every=2,
+                           max_failures=0, executor=SerialExecutor())
+    assert isinstance(ei.value.__cause__, InjectedFault)
+    with inject.armed(plan):
+        with pytest.raises(InjectedFault):
+            run_checkpointed(model, make_space(), CheckpointManager(
+                str(tmp_path / "c")), steps=4, every=2,
+                executor=SerialExecutor())
+
+
+# -- zero overhead when disarmed ----------------------------------------------
+
+def test_unarmed_seams_are_jaxpr_identical():
+    from mpi_model_tpu.parallel.halo import _chaos_ring
+
+    z = jnp.zeros((6, 6), jnp.float64)
+    ident = str(jax.make_jaxpr(lambda p: p)(z))
+    assert str(jax.make_jaxpr(lambda p: _chaos_ring(p, 1))(z)) == ident
+    # armed for a DIFFERENT site: the trace-time seam is still identity
+    with inject.armed(FaultPlan((Fault("torn", at=0),))):
+        assert (str(jax.make_jaxpr(lambda p: _chaos_ring(p, 1))(z))
+                == ident)
+    # armed halo fault: the seam now (and only now) changes the jaxpr
+    plan = FaultPlan((Fault("halo", value=1.0),))
+    with inject.armed(plan) as st:
+        with st.halo_window(plan.faults[0]):
+            assert (str(jax.make_jaxpr(lambda p: _chaos_ring(p, 1))(z))
+                    != ident)
+
+
+def test_step_jaxpr_unchanged_with_plan_armed():
+    """The executor seams sit OUTSIDE the jit boundary: the step jaxpr
+    built while a (non-halo) plan is armed is byte-identical to a clean
+    build — the zero-overhead contract behind the jaxpr_audit goldens."""
+    space = make_space()
+    sds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+           for k, v in space.values.items()}
+    clean = str(jax.make_jaxpr(make_model().make_step(space))(sds))
+    with inject.armed(FaultPlan((Fault("nan", at=0), Fault("exc")))):
+        armed_jaxpr = str(jax.make_jaxpr(
+            make_model().make_step(space))(sds))
+    assert armed_jaxpr == clean
+
+
+# -- ensemble chaos: poisoned lanes, quarantine, ladder, hangs ----------------
+
+def _scen_space(i, h=8, w=8):
+    v = jnp.asarray(np.roll(RNG_BASE, 3 * i, axis=0)[:h, :w], jnp.float64)
+    return CellularSpace.create(h, w, 1.0, dtype=jnp.float64).with_values(
+        {"value": v})
+
+
+def test_nonfinite_lane_is_flagged_not_waved_through():
+    """NaN totals fail the NaN > threshold comparison, so a poisoned
+    lane needs the explicit non-finite check — batchmates survive."""
+    model = make_model(4.0)
+    spaces = [_scen_space(i) for i in range(3)]
+    plan = FaultPlan((Fault("lane_nan", lane=1, at=0),))
+    with inject.armed(plan):
+        results = run_ensemble(model, spaces, steps=4,
+                               on_violation="mark")
+    assert isinstance(results[1], Exception)
+    assert "non-finite" in str(results[1])
+    for i in (0, 2):
+        sp, rep = results[i]
+        assert np.isfinite(np.asarray(sp.values["value"])).all()
+
+
+def test_scenario_fault_quarantined_batchmates_survive():
+    """A sticky lane fault: solo retry re-fails → quarantine with a
+    complete FailureEvent; batchmates are served, never retried."""
+    model = make_model(4.0)
+    sch = EnsembleScheduler(retry="solo", max_batch=3)
+    plan = FaultPlan((Fault("lane_nan", ticket=1, once=False),))
+    with inject.armed(plan):
+        t0, t1, t2 = [sch.submit(_scen_space(i), model, steps=4)
+                      for i in range(3)]
+        assert sch.poll(t0) is not None
+        with pytest.raises(Exception) as ei:
+            sch.poll(t1)
+        assert sch.poll(t2) is not None
+    err = ei.value
+    assert err.ticket == 1
+    ev = err.failure_event
+    assert (ev.kind == "nonfinite" and ev.ticket == 1
+            and ev.classification == "deterministic" and ev.attempt == 2)
+    st = sch.stats()
+    assert st["quarantined"] == 1 and st["solo_retries"] == 1
+    assert st["recovered_failures"] == 0
+    assert [e.ticket for e in sch.quarantine_log] == [1]
+
+
+def test_transient_lane_fault_recovered_by_solo_retry():
+    """A once-only lane fault vanishes when the scenario runs alone —
+    the scheduler recovers the result and reports the recovery."""
+    model = make_model(4.0)
+    sch = EnsembleScheduler(retry="solo", max_batch=2)
+    plan = FaultPlan((Fault("lane_nan", ticket=0, once=True),))
+    with inject.armed(plan):
+        a = sch.submit(_scen_space(0), model, steps=4)
+        b = sch.submit(_scen_space(1), model, steps=4)
+        ra, rb = sch.poll(a), sch.poll(b)
+    assert ra is not None and rb is not None
+    # the recovered lane's result equals its clean serial run bitwise
+    want, _ = model.execute(_scen_space(0), SerialExecutor(), steps=4)
+    np.testing.assert_array_equal(np.asarray(ra[0].values["value"]),
+                                  np.asarray(want.values["value"]))
+    st = sch.stats()
+    assert (st["recovered_failures"] == 1 and st["solo_retries"] == 1
+            and st["quarantined"] == 0)
+    # a lane fault that healed solo is evidence of a batch-level fault
+    assert st["impl_faults"] == 1
+    # the log reconciles with the counters: the batch entry names the
+    # retried ticket and the solo dispatch has its own entry
+    batch_entry, solo_entry = list(sch.dispatch_log)
+    assert batch_entry["retried_solo"] == [0]
+    assert (solo_entry["solo_retry"] and solo_entry["tickets"] == [0]
+            and solo_entry["outcome"] == "recovered")
+    assert st["dispatches"] == 2  # batch + solo, both billed
+
+
+def test_batch_fault_engages_degradation_ladder():
+    """An impl-level dispatch fault under impl='active': the ladder
+    degrades active→xla, solos recover every lane, and the served
+    reports say a degraded engine served them."""
+    model = make_model(4.0)
+    sch = EnsembleScheduler(impl="active", retry="solo", max_batch=2,
+                            degrade_after=1)
+    plan = FaultPlan((Fault("batch_exc", at=0),))
+    with inject.armed(plan):
+        with pytest.warns(RuntimeWarning, match="degraded to 'xla'"):
+            a = sch.submit(_scen_space(0), model, steps=4)
+            b = sch.submit(_scen_space(1), model, steps=4)
+            ra, rb = sch.poll(a), sch.poll(b)
+    assert ra is not None and rb is not None
+    st = sch.stats()
+    assert st["degraded_from"] == "active" and st["impl"] == "xla"
+    assert st["recovered_failures"] == 2 and st["impl_faults"] == 1
+    for res in (ra, rb):
+        assert res[1].backend_report["degraded_from"] == "active"
+        assert res[1].backend_report["impl"] == "xla"
+    # the error dispatch is in the log, honestly marked
+    assert any("error" in d for d in sch.dispatch_log)
+
+
+def test_hung_dispatch_times_out_and_solo_recovers():
+    clock = {"t": 0.0}
+    model = make_model(4.0)
+    sch = EnsembleScheduler(retry="solo", max_batch=2,
+                            dispatch_deadline_s=1.0,
+                            clock=lambda: clock["t"])
+    plan = FaultPlan((Fault("hang", at=0, seconds=5.0),))
+    with inject.armed(plan) as st:
+        a = sch.submit(_scen_space(0), model, steps=4)
+        b = sch.submit(_scen_space(1), model, steps=4)
+        ra, rb = sch.poll(a), sch.poll(b)
+    assert [f["kind"] for f in st.fired] == ["hang"]
+    assert ra is not None and rb is not None
+    s = sch.stats()
+    assert s["recovered_failures"] == 2 and s["impl_faults"] == 1
+    assert any("DispatchTimeout" in d.get("error", "")
+               for d in sch.dispatch_log)
+
+
+def test_hung_dispatch_without_retry_raises_timeout():
+    clock = {"t": 0.0}
+    model = make_model(4.0)
+    sch = EnsembleScheduler(max_batch=2, dispatch_deadline_s=1.0,
+                            clock=lambda: clock["t"])
+    plan = FaultPlan((Fault("hang", at=0, seconds=5.0),))
+    with inject.armed(plan):
+        a = sch.submit(_scen_space(0), model, steps=4)
+        b = sch.submit(_scen_space(1), model, steps=4)
+        for t in (a, b):
+            with pytest.raises(DispatchTimeout, match="deadline"):
+                sch.poll(t)
+
+
+# -- the CLI chaos surface ----------------------------------------------------
+
+def test_cli_chaos_run_recovers(capsys):
+    from mpi_model_tpu.cli import main
+
+    rc = main(["run", "--flow=diffusion", "--dimx=12", "--dimy=12",
+               "--steps=4", "--impl=xla", "--chaos=nan:1", "--json"])
+    row = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert row["conserved"] is True
+    assert row["injected_faults"] == 1
+    assert row["recovered_failures"] == 1
+
+
+def test_cli_chaos_validates_flags(capsys):
+    from mpi_model_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="halo"):
+        main(["run", "--chaos=halo"])
+    with pytest.raises(SystemExit, match="checkpoint-dir"):
+        main(["run", "--chaos=torn:2"])
+    with pytest.raises(SystemExit, match="unknown kind"):
+        main(["run", "--chaos=meteor"])
+    with pytest.raises(SystemExit, match="ensemble"):
+        main(["run", "--ensemble=2", "--chaos=nan"])
+
+
+# -- check_health costs one sync ----------------------------------------------
+
+def test_check_health_single_device_get(monkeypatch):
+    """The satellite fix: a multi-channel health check fetches ALL its
+    finite/total scalars in one jax.device_get."""
+    from mpi_model_tpu.resilience import check_health
+
+    space = make_space()
+    three = space.with_values({
+        "value": space.values["value"],
+        "b": jnp.ones_like(space.values["value"]),
+        "c": 2.0 * jnp.ones_like(space.values["value"]),
+    })
+    calls = {"n": 0}
+    real = jax.device_get
+
+    def counting(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    init = {k: float(three.total(k)) for k in three.values}
+    assert check_health(three, init, threshold=1e-6) == []
+    assert calls["n"] == 1
