@@ -535,9 +535,14 @@ def check_heavy_test(ctx: ModuleCtx):
 # the async staged-commit protocol.
 
 #: the raw checkpoint writers — callable only from the io layer itself
+#: (ISSUE 7 extends the set with the delta chain's raw record writer:
+#: a record written outside the chain's save path never reaches the
+#: chain manifest, so it would be an uncommitted — hence unrestorable —
+#: husk at best and a chain-corrupting overwrite at worst)
 CHECKPOINT_WRITERS = {"save_checkpoint", "save_checkpoint_sharded",
-                      "stage_checkpoint_sharded"}
-#: receiver names that read as a CheckpointManager (`mgr.save(...)`)
+                      "stage_checkpoint_sharded", "write_chain_record"}
+#: receiver names that read as a CheckpointManager or a DeltaChain
+#: (`mgr.save(...)`, `chain.save(...)`)
 _MANAGERISH = None  # compiled lazily; module-level re import kept local
 
 
@@ -546,18 +551,18 @@ def _managerish():
     if _MANAGERISH is None:
         import re
 
-        _MANAGERISH = re.compile(r"(manager|mgr|ckpt)", re.IGNORECASE)
+        _MANAGERISH = re.compile(r"(manager|mgr|ckpt|chain)", re.IGNORECASE)
     return _MANAGERISH
 
 
 def _save_boundary_module(ctx: ModuleCtx) -> bool:
-    """io/checkpoint.py, io/sharded.py and the resilience package are
-    the supervisor/flush boundaries the rule exempts."""
+    """io/checkpoint.py, io/sharded.py, io/delta.py and the resilience
+    package are the supervisor/flush boundaries the rule exempts."""
     parts = ctx.resolved_parts
     if "resilience" in parts:
         return True
     return (len(parts) >= 2 and parts[-2] == "io"
-            and parts[-1] in ("checkpoint.py", "sharded.py"))
+            and parts[-1] in ("checkpoint.py", "sharded.py", "delta.py"))
 
 
 @rule("naked-save", Severity.ERROR,

@@ -50,18 +50,24 @@ def _parse_chaos(args):
     (executor exception on chunk N), ``nan`` (NaN written into the state
     after chunk N), ``halo`` (ghost-ring perturbation during chunk N —
     sharded runs only), ``torn`` (the checkpoint written at step N is
-    torn on disk — requires --checkpoint-dir)."""
+    torn on disk — requires --checkpoint-dir; with the delta layout the
+    unpinned fault tears whichever record step N wrote), and the
+    delta-chain targets ``torn-keyframe``/``torn-delta``/``torn-chain``
+    (tear that specific record kind / the chain manifest — require
+    --checkpoint-layout=delta)."""
     if args.chaos is None:
         return None
     from .resilience.inject import Fault, FaultPlan
 
     spec = args.chaos
     kind, _, at_s = spec.partition(":")
-    if kind not in ("exc", "nan", "halo", "torn"):
+    known = ("exc", "nan", "halo", "torn", "torn-keyframe", "torn-delta",
+             "torn-chain")
+    if kind not in known:
         raise SystemExit(
             f"--chaos={spec!r}: unknown kind {kind!r} (expected "
-            "exc|nan|halo|torn, optionally ':N' for the chunk/step to "
-            "fire at)")
+            "exc|nan|halo|torn|torn-keyframe|torn-delta|torn-chain, "
+            "optionally ':N' for the chunk/step to fire at)")
     try:
         at = int(at_s) if at_s else None
     except ValueError:
@@ -71,12 +77,26 @@ def _parse_chaos(args):
         raise SystemExit(
             "--chaos=halo perturbs the ghost-ring exchange; add "
             "--mesh=LxC (serial runs have no halos)")
-    if kind == "torn":
+    if kind.startswith("torn"):
         if args.checkpoint_dir is None:
             raise SystemExit(
-                "--chaos=torn tears a written checkpoint; add "
+                f"--chaos={kind} tears a written checkpoint; add "
                 "--checkpoint-dir=DIR")
-        tear = Fault("torn", at=at, tear="truncate", offset=64)
+        part = kind.partition("-")[2] or None
+        if part is not None and args.checkpoint_layout != "delta":
+            raise SystemExit(
+                f"--chaos={kind} targets a delta-chain "
+                f"{'manifest' if part == 'chain' else part + ' record'}, "
+                f"which --checkpoint-layout={args.checkpoint_layout} "
+                "never writes; use --checkpoint-layout=delta (or plain "
+                "--chaos=torn for this layout's files)")
+        # commit records are json — corrupt them (truncation at a byte
+        # offset is the data-record tear)
+        tear = (Fault("torn", at=at, channel="chain", tear="corrupt",
+                      offset=2)
+                if part == "chain"
+                else Fault("torn", at=at, channel=part, tear="truncate",
+                           offset=64))
         return FaultPlan((tear,), seed=args.chaos_seed)
     return FaultPlan((Fault(kind, at=at),), seed=args.chaos_seed)
 
@@ -377,6 +397,17 @@ def cmd_run(args) -> int:
         raise SystemExit(
             "--checkpoint-layout/--async-checkpoints configure "
             "checkpointing; add --checkpoint-dir=DIR")
+    if args.keyframe_every is not None:
+        if args.checkpoint_layout != "delta":
+            raise SystemExit(
+                "--keyframe-every sets the delta chain's keyframe "
+                "cadence; it does nothing for "
+                f"--checkpoint-layout={args.checkpoint_layout} (use "
+                "--checkpoint-layout=delta)")
+        if args.keyframe_every < 1:
+            raise SystemExit(
+                f"--keyframe-every={args.keyframe_every} must be >= 1 "
+                "(1 = every save is a keyframe)")
     chaos_plan = _parse_chaos(args)
     injected = 0
     if args.checkpoint_dir or chaos_plan is not None:
@@ -390,7 +421,9 @@ def cmd_run(args) -> int:
         # in-memory rollback path); a manager adds durability on top
         manager = (CheckpointManager(args.checkpoint_dir,
                                      layout=args.checkpoint_layout,
-                                     async_writes=args.async_checkpoints)
+                                     async_writes=args.async_checkpoints,
+                                     keyframe_every=(args.keyframe_every
+                                                     or 8))
                    if args.checkpoint_dir else None)
         arm = (inject.armed(chaos_plan) if chaos_plan is not None
                else contextlib.nullcontext())
@@ -618,9 +651,17 @@ def main(argv: Optional[list[str]] = None) -> int:
     run.add_argument("--checkpoint-dir", default=None)
     run.add_argument("--checkpoint-every", type=int, default=1)
     run.add_argument("--checkpoint-layout", default="full",
-                     choices=("full", "sharded"),
+                     choices=("full", "sharded", "delta"),
                      help="'sharded' = per-process O(shard) files, no "
-                          "full-grid gather (io/sharded.py)")
+                          "full-grid gather (io/sharded.py); 'delta' = "
+                          "incremental chain: periodic keyframes + "
+                          "dirty-tile delta records, restore replays "
+                          "the chain (io/delta.py) — a snapshot costs "
+                          "O(dirty tiles), not O(grid)")
+    run.add_argument("--keyframe-every", type=int, default=None,
+                     help="delta layout: records per chain segment "
+                          "(1 keyframe + N-1 deltas; default 8; 1 = "
+                          "every save is a keyframe)")
     run.add_argument("--async-checkpoints", action="store_true",
                      help="overlap checkpoint writes with compute "
                           "(requires --checkpoint-layout=sharded)")

@@ -134,6 +134,10 @@ class EnsembleScheduler:
         self._impl_fault_count = 0
         #: one FailureEvent per quarantined scenario, in quarantine order
         self.quarantine_log: list = []
+        #: live-migration accounting (migrate_ticket): scenarios drained
+        #: to / received from another scheduler
+        self.migrated_out = 0
+        self.migrated_in = 0
         self._queues: collections.OrderedDict[tuple, list[_Pending]] = \
             collections.OrderedDict()
         self._results: dict[int, object] = {}
@@ -208,6 +212,56 @@ class EnsembleScheduler:
         while self._queues:
             n += self.pump(force=True)
         return n
+
+    def migrate_ticket(self, ticket: int,
+                       target: "EnsembleScheduler") -> int:
+        """Drain one QUEUED scenario off this scheduler and resubmit it
+        on ``target`` — the live rebalancing primitive (ISSUE 7): the
+        scenario's state crosses through the delta-stream wire format
+        (``io.delta.transfer_space`` — a keyframe record whose every
+        piece is CRC32-verified at materialization), so the handoff is
+        bitwise and a corrupted transfer fails loudly instead of
+        resuming wrong state. Neither scheduler stops the world: other
+        tickets keep batching on both sides, and the target is free to
+        run a different bucket ladder, impl or retry policy.
+
+        Returns the new ticket on ``target``; the old ticket is
+        forgotten here (polling it raises KeyError, the collected-
+        ticket contract). A ticket already dispatched/served cannot
+        migrate — collect its result instead."""
+        if target is self:
+            raise ValueError(
+                "migrate_ticket needs a DIFFERENT target scheduler "
+                "(migrating onto oneself is a no-op with extra steps)")
+        if ticket in self._results:
+            raise KeyError(
+                f"ticket {ticket} is already served — collect it with "
+                "poll() instead of migrating it")
+        if ticket not in self._pending_tickets:
+            raise KeyError(f"unknown or already-collected ticket {ticket}")
+        for key, q in self._queues.items():
+            for i, it in enumerate(q):
+                if it.ticket != ticket:
+                    continue
+                from ..io.delta import transfer_space
+
+                # verify-then-drain: a transfer that fails its CRCs
+                # raises HERE, with the scenario still queued locally
+                space = transfer_space(it.space)
+                q.pop(i)
+                if not q:
+                    del self._queues[key]
+                self._pending_tickets.discard(ticket)
+                new_ticket = target.submit(space, it.model, it.steps)
+                self.migrated_out += 1
+                target.migrated_in += 1
+                self.dispatch_log.append({
+                    "migrated_ticket": ticket, "to_ticket": new_ticket,
+                    "steps": it.steps,
+                })
+                return new_ticket
+        raise KeyError(  # pragma: no cover - pending implies queued
+            f"ticket {ticket} is pending but not queued")
 
     def flush_ticket(self, ticket: int) -> int:
         """Dispatch only the group holding ``ticket`` until that ticket
@@ -492,5 +546,7 @@ class EnsembleScheduler:
             "buckets": list(self.buckets),
             "retry": self.retry,
             "degraded_from": self.degraded_from,
+            "migrated_out": self.migrated_out,
+            "migrated_in": self.migrated_in,
         })
         return out

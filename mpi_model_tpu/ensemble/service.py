@@ -81,6 +81,14 @@ class EnsembleService:
             raise RuntimeError(f"ticket {ticket} still pending after flush")
         return res
 
+    def migrate(self, ticket: int, target: "EnsembleService") -> int:
+        """Move one queued scenario to ``target`` service through the
+        CRC-verified delta-stream handoff
+        (``EnsembleScheduler.migrate_ticket``) and return its new
+        ticket THERE — rebalancing between services (different bucket
+        ladders, impls, machines-to-be) without stopping either."""
+        return self.scheduler.migrate_ticket(ticket, target.scheduler)
+
     def flush(self) -> int:
         """Dispatch everything queued; returns the dispatch count."""
         return self.scheduler.drain()

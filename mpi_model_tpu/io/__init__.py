@@ -12,6 +12,13 @@ from .sharded import (
     save_checkpoint_sharded,
     stage_checkpoint_sharded,
 )
+from .delta import (
+    DeltaChain,
+    MigrationError,
+    MigrationResult,
+    migrate_scenario,
+    transfer_space,
+)
 from .output import (
     merge_dumps,
     output_filename,
@@ -31,6 +38,11 @@ __all__ = [
     "is_sharded_checkpoint",
     "stage_checkpoint_sharded",
     "commit_checkpoint_sharded",
+    "DeltaChain",
+    "MigrationError",
+    "MigrationResult",
+    "migrate_scenario",
+    "transfer_space",
     "partition_dump_lines",
     "write_partition_dump",
     "merge_dumps",

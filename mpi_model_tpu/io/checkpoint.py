@@ -178,26 +178,44 @@ def load_checkpoint(path: str) -> Checkpoint:
 class CheckpointManager:
     """Periodic checkpoints in one directory, pruned to the newest ``keep``.
 
-    File layout: ``{prefix}_{step:010d}.npz`` (dense, the default) or a
+    File layout: ``{prefix}_{step:010d}.npz`` (dense, the default), a
     ``{prefix}_{step:010d}.ckpt`` directory (``layout="sharded"``, the
-    O(shard) per-process format — ``io.sharded``). The step counter is
-    the checkpoint identity, so ``latest()`` is a filename sort, not a
-    mtime race; ``restore`` auto-detects the layout on disk, so a run
-    can switch layouts and still resume.
+    O(shard) per-process format — ``io.sharded``), or the incremental
+    delta chain (``layout="delta"`` — ``io.delta``: periodic keyframes
+    + dirty-tile delta records linked by a chain manifest; restore
+    replays the chain, so a snapshot costs O(dirty tiles), not
+    O(grid)). The step counter is the checkpoint identity, so
+    ``latest()`` is a filename sort, not a mtime race; ``restore``
+    auto-detects the layout on disk, so a run can switch layouts and
+    still resume.
+
+    ``keyframe_every`` (delta layout) bounds a chain segment to that
+    many records (1 keyframe + N-1 deltas); ``delta_tile`` overrides
+    the delta records' tile dims (default: the active engine's
+    128²-preferred grid).
     """
 
     def __init__(self, directory: str, keep: int = 3, prefix: str = "ckpt",
-                 layout: str = "full", async_writes: bool = False):
-        if layout not in ("full", "sharded"):
-            raise ValueError(f"layout must be 'full' or 'sharded': {layout!r}")
+                 layout: str = "full", async_writes: bool = False,
+                 keyframe_every: int = 8,
+                 delta_tile: Optional[tuple] = None):
+        if layout not in ("full", "sharded", "delta"):
+            raise ValueError(
+                f"layout must be 'full', 'sharded' or 'delta': {layout!r}")
         if async_writes and layout != "sharded":
             raise ValueError(
                 "async_writes requires layout='sharded' (the staged "
                 "write/deferred-manifest protocol is the sharded format's)")
+        if keyframe_every < 1:
+            raise ValueError(
+                f"keyframe_every must be >= 1, got {keyframe_every}")
         self.directory = directory
         self.keep = int(keep)
         self.prefix = prefix
         self.layout = layout
+        self.keyframe_every = int(keyframe_every)
+        self.delta_tile = delta_tile
+        self._chain_obj = None
         #: overlap shard-file writes with the next compute chunk: save()
         #: snapshots device shards to host and returns immediately; a
         #: background thread writes the file and the COMMIT (barrier +
@@ -209,40 +227,80 @@ class CheckpointManager:
         self._pending = None  # (thread, err_box, staged)
         os.makedirs(directory, exist_ok=True)
 
+    @property
+    def _chain(self):
+        """The delta chain bound to this directory/prefix (io.delta) —
+        built lazily so non-delta managers never import the module."""
+        if self._chain_obj is None:
+            from .delta import DeltaChain
+
+            self._chain_obj = DeltaChain(
+                self.directory, prefix=self.prefix,
+                keyframe_every=self.keyframe_every, tile=self.delta_tile)
+        return self._chain_obj
+
     def path_for(self, step: int, layout: Optional[str] = None) -> str:
-        suffix = ".ckpt" if (layout or self.layout) == "sharded" else ".npz"
+        layout = layout or self.layout
+        if layout == "delta":
+            # advisory: the kind on disk wins (a chain step is a
+            # keyframe or a delta record); default to the keyframe name
+            dp = self._chain.record_path(step, "delta")
+            kp = self._chain.record_path(step, "keyframe")
+            return dp if (os.path.exists(dp)
+                          and not os.path.exists(kp)) else kp
+        suffix = ".ckpt" if layout == "sharded" else ".npz"
         return os.path.join(
             self.directory, f"{self.prefix}_{step:010d}{suffix}")
 
-    def _on_disk(self, step: int) -> str:
-        """The path that actually exists for ``step`` — preferring the
-        layout this manager was CONFIGURED with when both exist (a run
-        that switched layouts and re-saved the same step leaves the
-        other layout's file stale; picking it silently would restore old
-        state — round-4 ADVICE)."""
-        other = "sharded" if self.layout == "full" else "full"
-        preferred = self.path_for(step, self.layout)
-        fallback = self.path_for(step, other)
-        if os.path.exists(preferred):
-            if os.path.exists(fallback):
-                warnings.warn(
-                    f"step {step} exists in BOTH layouts "
-                    f"({os.path.basename(preferred)} and "
-                    f"{os.path.basename(fallback)}); restoring the "
-                    f"manager's configured layout {self.layout!r} — the "
-                    f"other file may be stale", stacklevel=3)
-            return preferred
-        if os.path.exists(fallback):
-            return fallback
-        raise FileNotFoundError(
-            f"no checkpoint for step {step} in {self.directory}")
+    def _exists(self, step: int, layout: str) -> bool:
+        if layout == "delta":
+            return self._chain.has_step(step)
+        return os.path.exists(self.path_for(step, layout))
 
-    def steps(self) -> list[int]:
+    def _layout_on_disk(self, step: int) -> str:
+        """The layout that actually holds ``step`` — preferring the one
+        this manager was CONFIGURED with when several exist (a run that
+        switched layouts and re-saved the same step leaves the other
+        layout's file stale; picking it silently would restore old
+        state — round-4 ADVICE)."""
+        order = [self.layout] + [ly for ly in ("full", "sharded", "delta")
+                                 if ly != self.layout]
+        if self.layout != "delta" and not self._scan_files()[1]:
+            # chain-free directory: a full/sharded manager never pays
+            # the chain's manifest/listdir probe (the lazy contract —
+            # latest() calls here once per fallback step)
+            order.remove("delta")
+        found = [ly for ly in order if self._exists(step, ly)]
+        if not found:
+            raise FileNotFoundError(
+                f"no checkpoint for step {step} in {self.directory}")
+        if len(found) > 1:
+            warnings.warn(
+                f"step {step} exists in BOTH layouts "
+                f"({' and '.join(found)}); restoring the manager's "
+                f"configured layout {found[0]!r} — the other file may "
+                "be stale", stacklevel=3)
+        return found[0]
+
+    def _file_steps(self) -> list[int]:
+        """Steps present as full/sharded per-step files (the delta
+        chain's committed steps are the chain's to report — and to
+        prune, since a chain record is never individually deletable)."""
+        return self._scan_files()[0]
+
+    def _scan_files(self) -> tuple[list[int], bool]:
+        """(full/sharded steps on disk, whether any delta-chain
+        artifact was seen) in one directory pass."""
         from .sharded import is_sharded_checkpoint
 
         out = set()
+        saw_chain = os.path.exists(os.path.join(
+            self.directory, f"{self.prefix}_chain.json"))
         for fn in os.listdir(self.directory):
             if not fn.startswith(self.prefix + "_"):
+                continue
+            if fn.endswith(".kf.npz") or fn.endswith(".d.npz"):
+                saw_chain = True
                 continue
             stem, ext = os.path.splitext(fn)
             if ext not in (".npz", ".ckpt"):
@@ -257,10 +315,28 @@ class CheckpointManager:
                 out.add(int(stem[len(self.prefix) + 1:]))
             except ValueError:
                 continue
-        return sorted(out)
+        return sorted(out), saw_chain
+
+    def steps(self) -> list[int]:
+        file_steps, saw_chain = self._scan_files()
+        if self.layout != "delta" and not saw_chain:
+            # a full/sharded manager in a chain-free directory never
+            # pays the chain's manifest read (the lazy contract)
+            return file_steps
+        return sorted(set(file_steps) | set(self._chain.steps()))
 
     def save(self, space: CellularSpace, step: int,
-             extra: Optional[dict] = None) -> str:
+             extra: Optional[dict] = None, *,
+             dirty_tiles: Optional[dict] = None) -> str:
+        """``dirty_tiles`` (delta layout only) is the active engine's
+        dirty-tile export for the interval since the LAST save — the
+        activity-sourced dirtiness that lets the delta writer skip its
+        full-grid diff; other layouts ignore it."""
+        if self.layout == "delta":
+            path = self._chain.save(space, step, extra,
+                                    dirty_tiles=dirty_tiles)
+            self._prune(keep_path=path)
+            return path
         if self.async_writes:
             import threading
 
@@ -328,7 +404,12 @@ class CheckpointManager:
 
                 from .sharded import is_sharded_checkpoint
 
-                for old in self.steps()[:-self.keep]:
+                if self.layout == "delta":
+                    # chain retention is the chain's own job: keep-N
+                    # respecting segment integrity (a keyframe that
+                    # live deltas replay from is never deleted)
+                    self._chain.prune(self.keep)
+                for old in self._file_steps()[:-self.keep]:
                     # a layout-switch run can leave one step in BOTH
                     # layouts; prune must clear both (removing only the
                     # configured one would resurrect the stale other
@@ -381,8 +462,14 @@ class CheckpointManager:
             f"(newest error: {last_err})") from last_err
 
     def restore(self, step: int, *, mesh=None, spec=None) -> Checkpoint:
-        path = self._on_disk(step)
-        if os.path.isdir(path):
+        layout = self._layout_on_disk(step)
+        if layout == "delta":
+            # chain replay assembles full host arrays (the dense
+            # layout's restore semantics; re-sharding is the executor's
+            # job on the next run, so mesh/spec do not apply)
+            return self._chain.restore(step)
+        path = self.path_for(step, layout)
+        if layout == "sharded":
             from .sharded import load_checkpoint_sharded
 
             return load_checkpoint_sharded(path, mesh=mesh, spec=spec)

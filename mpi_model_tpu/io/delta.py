@@ -1,0 +1,743 @@
+"""Incremental (dirty-tile) checkpointing + the live-migration stream.
+
+The full-grid layouts make every snapshot cost O(grid): at the bench
+geometry (16384² f32) that is a ~1 GB write per checkpoint interval —
+the dominant cost of a tight-interval supervised run, and the reason an
+operator turns the PR 5 safety net off. The active-tile engine (PR 3)
+already knows which tiles a run wrote; this module turns that into a
+**delta chain**:
+
+- a chain is a sequence of records in the manager's directory: periodic
+  full **keyframes** (``{prefix}_{step:010d}.kf.npz``) and per-interval
+  **delta records** (``{prefix}_{step:010d}.d.npz``) holding only the
+  DIRTY tiles — each record a piece table + raw payload in the PR 5
+  sharded-manifest shape (``{channel, start, shape, key, crc32}`` per
+  piece; a keyframe's pieces cover each channel whole, a delta's pieces
+  are the dirty tiles);
+- dirtiness comes from the active engine's dirty-tile export
+  (``SerialExecutor.last_dirty_tiles`` — the union of tiles the run
+  wrote, a guaranteed superset of changed tiles) when the caller can
+  vouch for one, else from ``ops.active.changed_tile_map`` — a
+  byte-level tile diff against the last saved state (always correct,
+  costs one vectorized compare over the grid);
+- ``{prefix}_chain.json`` is the chain manifest — the COMMIT record
+  (the sharded layout's manifest discipline): records are linked by
+  ``base`` step, and a record not in the manifest does not exist;
+- restore REPLAYS: load the nearest keyframe at-or-before the target,
+  apply each delta in order, verifying every piece CRC32 and every
+  base link. A torn, CRC-failing or missing record makes the restore
+  raise ``CheckpointCorruptionError`` — ``CheckpointManager.latest()``
+  then falls back to the previous step, which truncates the chain at
+  the last record that VERIFIES. A missing/unreadable chain manifest
+  degrades the chain to its self-contained keyframes (with a warning)
+  — never a silent fresh start, never a silently stale delta.
+
+The same record format is the **live-migration stream**:
+``migrate_scenario`` hands a running scenario between executors
+(serial ↔ sharded) by snapshotting a keyframe, letting the source keep
+stepping while the bulk copy is "in flight", then shipping only the
+dirty-tile delta at cutover and resuming on the target after a
+bitwise verification — the rebalancing primitive that doesn't stop the
+world. ``transfer_space`` is the one-shot (keyframe-only) form the
+ensemble scheduler's ``migrate_ticket`` uses to drain a queued
+scenario onto another scheduler through the same CRC-verified wire
+format.
+
+Checkpoints are host-side like the dense layout: channels are gathered
+with the multihost-safe global gather, only process 0 writes, and the
+chain writer's in-memory last-saved state makes the tile diff local.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import warnings
+import zlib
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.cellular_space import CellularSpace
+from ..ops.active import ActivePlan, changed_tile_map, plan_for
+from ..resilience import inject
+from .checkpoint import Checkpoint, CheckpointCorruptionError
+from .sharded import _atomic_write
+
+DELTA_FORMAT_VERSION = 1
+SUFFIX_KEYFRAME = ".kf.npz"
+SUFFIX_DELTA = ".d.npz"
+
+
+class MigrationError(RuntimeError):
+    """A live-migration handoff failed its bitwise verification: the
+    state materialized on the target does not reproduce the source's
+    byte for byte (a dirty map that missed a changed tile, or a payload
+    corrupted in flight). The source's state is untouched — the caller
+    keeps running there."""
+
+
+# -- piece encoding (the PR 5 sharded piece table, tile-grained) --------------
+
+def _geom_meta(space) -> dict:
+    return {
+        "dim_x": space.dim_x, "dim_y": space.dim_y,
+        "x_init": space.x_init, "y_init": space.y_init,
+        "global_dim_x": space.global_dim_x,
+        "global_dim_y": space.global_dim_y,
+    }
+
+
+def _channels_meta(values: dict) -> dict:
+    return {k: {"dtype": str(v.dtype), "shape": list(v.shape)}
+            for k, v in values.items()}
+
+
+def _piece(channel: str, start, shape, raw: np.ndarray, key: str) -> dict:
+    return {"channel": channel, "start": list(start), "shape": list(shape),
+            "key": key, "crc32": zlib.crc32(raw) & 0xFFFFFFFF}
+
+
+def _full_pieces(values: dict) -> tuple[list, dict]:
+    """One piece per channel covering it whole — a keyframe's table."""
+    pieces, payload = [], {}
+    for name, arr in values.items():
+        raw = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+        key = f"d:{len(pieces)}"
+        pieces.append(_piece(name, (0,) * arr.ndim, arr.shape, raw, key))
+        payload[key] = raw
+    return pieces, payload
+
+
+def _tile_pieces(values: dict, plan: ActivePlan,
+                 dirty: dict[str, np.ndarray]) -> tuple[list, dict]:
+    """Dirty tiles as pieces: ``dirty`` maps channel → bool [gi, gj]
+    (a superset of the tiles whose bytes changed)."""
+    (th, tw) = plan.tile
+    pieces, payload = [], {}
+    for name, arr in values.items():
+        dmap = dirty[name]
+        for ti, tj in zip(*np.nonzero(dmap)):
+            r, c = int(ti) * th, int(tj) * tw
+            tile = np.ascontiguousarray(arr[r:r + th, c:c + tw])
+            raw = tile.reshape(-1).view(np.uint8)
+            key = f"d:{len(pieces)}"
+            pieces.append(_piece(name, (r, c), (th, tw), raw, key))
+            payload[key] = raw
+    return pieces, payload
+
+
+def _apply_pieces(arrays: dict[str, np.ndarray], meta: dict, get_raw,
+                  where: str) -> None:
+    """Apply a record's pieces onto ``arrays`` in place, verifying every
+    piece's CRC32 against the bytes read."""
+    for piece in meta["pieces"]:
+        ch = piece["channel"]
+        dst = arrays.get(ch)
+        if dst is None:
+            raise CheckpointCorruptionError(
+                f"record {where} carries channel {ch!r} the chain's "
+                "keyframe does not (channel set changed mid-chain)")
+        raw = np.asarray(get_raw(piece["key"])).reshape(-1)
+        want = piece.get("crc32")
+        if want is not None and (zlib.crc32(raw) & 0xFFFFFFFF) != want:
+            raise CheckpointCorruptionError(
+                f"piece {piece['key']} (channel {ch!r}) in {where} fails "
+                "its CRC32 (bytes changed since the record was written)")
+        sel = tuple(slice(s, s + n)
+                    for s, n in zip(piece["start"], piece["shape"]))
+        try:
+            dst[sel] = raw.view(dst.dtype).reshape(piece["shape"])
+        except ValueError as e:
+            raise CheckpointCorruptionError(
+                f"piece {piece['key']} in {where} does not fit channel "
+                f"{ch!r}: {e}") from e
+
+
+def _new_arrays(channels: dict) -> dict[str, np.ndarray]:
+    return {name: np.empty(tuple(ch["shape"]), dtype=jnp.dtype(ch["dtype"]))
+            for name, ch in channels.items()}
+
+
+# -- the raw record writer (lint boundary: naked-save covers it) --------------
+
+def write_chain_record(path: str, meta: dict, payload: dict) -> str:
+    """Write one chain record file atomically (tmp + replace) and fire
+    the chaos seam for its kind. RAW writer — outside ``io``/
+    ``resilience`` all writes must flow through ``CheckpointManager``
+    (the ``naked-save`` analysis rule enforces this), or the chain
+    manifest stops being a commit record."""
+    body = dict(payload)
+    body["meta"] = np.frombuffer(json.dumps(meta).encode("utf-8"),
+                                 dtype=np.uint8)
+    _atomic_write(path, lambda f: np.savez(f, **body))
+    # chaos seam (resilience.inject): an armed "torn" fault damages the
+    # just-committed record — part "keyframe" or "delta" (an unpinned
+    # "data" fault matches either)
+    inject.checkpoint_torn(path, int(meta["step"]), part=meta["kind"])
+    return path
+
+
+class _RecordReader:
+    """One chain record file: meta up front, piece bytes on demand
+    (``np.load`` keeps zip members unread until indexed) — the sharded
+    layout's lazy reader, chain-record flavored."""
+
+    def __init__(self, path: str):
+        import zipfile
+
+        self.path = path
+        try:
+            self._z = np.load(path)
+        except FileNotFoundError:
+            # a MISSING chain record is corruption at this layer: the
+            # manifest promised it, so the chain is broken here — typed
+            # so latest() truncates to the last verified record
+            raise CheckpointCorruptionError(
+                f"chain record {path} is missing (the chain manifest "
+                "references it)")
+        except (zipfile.BadZipFile, EOFError, KeyError, OSError,
+                ValueError) as e:
+            raise CheckpointCorruptionError(
+                f"chain record {path} is torn/unreadable: "
+                f"{type(e).__name__}: {e}") from e
+        try:
+            self.meta = json.loads(bytes(self._z["meta"]).decode("utf-8"))
+            if self.meta.get("format") != DELTA_FORMAT_VERSION:
+                raise CheckpointCorruptionError(
+                    f"chain record {path} has unsupported format "
+                    f"{self.meta.get('format')!r}")
+        except CheckpointCorruptionError:
+            self._z.close()  # a raising __init__ must not leak the zip
+            raise
+        except (zipfile.BadZipFile, EOFError, KeyError, OSError,
+                ValueError, UnicodeDecodeError) as e:
+            self._z.close()
+            raise CheckpointCorruptionError(
+                f"chain record {path} is torn/unreadable: "
+                f"{type(e).__name__}: {e}") from e
+
+    def raw(self, key: str) -> np.ndarray:
+        import zipfile
+
+        try:
+            return self._z[key]
+        except (zipfile.BadZipFile, KeyError, OSError, ValueError,
+                EOFError) as e:
+            # the zip layer's own member CRC can catch the damage before
+            # this format's per-piece CRC32 does; both mean corruption
+            raise CheckpointCorruptionError(
+                f"piece {key} in {self.path} is unreadable: "
+                f"{type(e).__name__}: {e}") from e
+
+    def close(self) -> None:
+        self._z.close()
+
+
+# -- the chain --------------------------------------------------------------
+
+class DeltaChain:
+    """One delta-checkpoint chain in a directory (module docstring has
+    the format). The in-memory ``_last_values`` snapshot is what makes
+    the tile diff local; after a restart it is empty, so the first save
+    is a keyframe — the conservative, always-correct restart.
+    ``keyframe_every`` bounds a chain segment to that many RECORDS
+    (1 keyframe + keyframe_every-1 deltas); 1 makes every save a
+    keyframe (≈ the dense layout with chain bookkeeping)."""
+
+    def __init__(self, directory: str, prefix: str = "ckpt",
+                 keyframe_every: int = 8,
+                 tile: Optional[tuple[int, int]] = None):
+        self.directory = directory
+        self.prefix = prefix
+        self.keyframe_every = max(1, int(keyframe_every))
+        #: tile dims for delta records (None → ops.active.plan_for's
+        #: default, 128²-preferred divisors — the active engine's grid)
+        self.tile = tile
+        self._last_values: Optional[dict[str, np.ndarray]] = None
+        self._last_step: Optional[int] = None
+        #: (manifest stat signature, steps) — see steps()
+        self._steps_cache: Optional[tuple] = None
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.directory, f"{self.prefix}_chain.json")
+
+    def record_path(self, step: int, kind: str) -> str:
+        suffix = SUFFIX_KEYFRAME if kind == "keyframe" else SUFFIX_DELTA
+        return os.path.join(self.directory,
+                            f"{self.prefix}_{step:010d}{suffix}")
+
+    def _manifest(self) -> tuple[Optional[list], Optional[str]]:
+        """(records, error): records is None when the manifest is
+        missing; error carries the unreadable-manifest detail (records
+        None too) — the degraded keyframes-only mode."""
+        try:
+            with open(self.manifest_path) as f:
+                doc = json.load(f)
+            return list(doc["records"]), None
+        except FileNotFoundError:
+            return None, None
+        except (json.JSONDecodeError, UnicodeDecodeError, KeyError,
+                TypeError, OSError) as e:
+            return None, f"{type(e).__name__}: {e}"
+
+    def _write_manifest(self, records: list) -> None:
+        doc = {"format": DELTA_FORMAT_VERSION, "prefix": self.prefix,
+               "keyframe_every": self.keyframe_every, "records": records}
+        _atomic_write(self.manifest_path,
+                      lambda f: f.write(json.dumps(doc, indent=1).encode()))
+
+    # -- save ---------------------------------------------------------------
+
+    def save(self, space: CellularSpace, step: int,
+             extra: Optional[dict] = None,
+             dirty_tiles: Optional[dict] = None) -> str:
+        """Append one record for ``step``: a keyframe on the first save,
+        at the ``keyframe_every`` cadence, or whenever the writer cannot
+        vouch for a delta (restart, manifest damage, geometry change);
+        a dirty-tile delta otherwise. Records at steps >= ``step`` are
+        retracted first (a resumed run recomputes them), so the chain
+        stays a single timeline.
+
+        ``dirty_tiles`` is the active engine's export
+        ({"tile", "grid", "map"}); it is used only when its tile grid
+        matches this chain's, else the writer falls back to the byte
+        diff against the last saved state."""
+        from ..parallel.multihost import gather_global, master_only
+
+        step = int(step)
+        values = {k: np.ascontiguousarray(gather_global(v))
+                  for k, v in space.values.items()}
+        # the tile plan must follow the GATHERED arrays: under
+        # jax.distributed space.shape is the local partition, while
+        # gather_global returns the global grid — a local-shaped plan
+        # would silently regroup the wrong bytes into "tiles"
+        gshape = next(iter(values.values())).shape
+        plan = plan_for(gshape, tile=self.tile)
+        records, _merr = self._manifest()
+        if records is None:
+            # missing/unreadable manifest: adopt the surviving
+            # self-contained keyframes into the rebuilt manifest (each
+            # its own one-record segment) — rebuilding from only the
+            # new record would let the next prune's orphan sweep delete
+            # verified history the degraded mode promised to keep
+            records = [
+                {"step": s, "kind": "keyframe",
+                 "file": os.path.basename(self.record_path(s, "keyframe")),
+                 "base": None}
+                for s in self._keyframes_on_disk()]
+        keep = [r for r in records if r["step"] < step]
+        dropped = [r for r in records if r["step"] >= step]
+        tail = keep[-1] if keep else None
+
+        prev_ok = (
+            tail is not None
+            and self._last_step == tail["step"]
+            and self._last_values is not None
+            and set(self._last_values) == set(values)
+            and all(self._last_values[k].shape == values[k].shape
+                    and self._last_values[k].dtype == values[k].dtype
+                    for k in values))
+        since_kf, has_kf = 0, False
+        for r in reversed(keep):
+            if r["kind"] == "keyframe":
+                has_kf = True
+                break
+            since_kf += 1
+        kind = ("delta" if (prev_ok and has_kf
+                            and since_kf + 1 < self.keyframe_every)
+                else "keyframe")
+        if kind == "delta":
+            dirty = self._dirty_maps(values, plan, dirty_tiles)
+            th, tw = plan.tile
+            dbytes = sum(int(dirty[k].sum()) * th * tw * v.dtype.itemsize
+                         for k, v in values.items())
+            if dbytes >= sum(v.nbytes for v in values.values()):
+                # a delta dirtier than the grid costs MORE than a
+                # keyframe (per-piece overhead on top of the payload):
+                # write the keyframe — which also restarts the segment,
+                # so replay chains never grow through dense phases
+                kind = "keyframe"
+
+        if kind == "keyframe":
+            pieces, payload = _full_pieces(values)
+        else:
+            pieces, payload = _tile_pieces(values, plan, dirty)
+        meta = {
+            "format": DELTA_FORMAT_VERSION,
+            "kind": kind,
+            "step": step,
+            "base": tail["step"] if kind == "delta" else None,
+            **_geom_meta(space),
+            "channels": _channels_meta(values),
+            "extra": extra or {},
+            "tile": list(plan.tile),
+            "pieces": pieces,
+        }
+        path = self.record_path(step, kind)
+        entry = {"step": step, "kind": kind,
+                 "file": os.path.basename(path),
+                 "base": meta["base"]}
+        with master_only("delta-ckpt-save") as master:
+            if master:
+                os.makedirs(self.directory, exist_ok=True)
+                write_chain_record(path, meta, payload)
+                self._write_manifest(keep + [entry])
+                # chaos seam: a "torn" fault pinned to part "chain"
+                # damages the commit record itself
+                inject.checkpoint_torn(self.manifest_path, step,
+                                       part="chain")
+                # retracted records' files and a stale other-kind file
+                # at this step are no longer referenced — clear them
+                other = self.record_path(
+                    step, "delta" if kind == "keyframe" else "keyframe")
+                for p in [os.path.join(self.directory, r["file"])
+                          for r in dropped] + [other]:
+                    if os.path.exists(p) and os.path.abspath(p) \
+                            != os.path.abspath(path):
+                        os.unlink(p)
+        self._last_values = values
+        self._last_step = step
+        return path
+
+    def _dirty_maps(self, values: dict, plan: ActivePlan,
+                    dirty_tiles: Optional[dict]) -> dict:
+        """Per-channel dirty maps for a delta record: the supplied
+        activity export when its tile grid matches this chain's plan
+        (one map for every channel — it is a superset of every write
+        the run made), else the byte-level tile diff per channel."""
+        if (dirty_tiles is not None
+                and tuple(dirty_tiles.get("tile", ())) == plan.tile
+                and tuple(dirty_tiles.get("grid", ())) == plan.grid):
+            dmap = np.asarray(dirty_tiles["map"], bool)
+            return {k: dmap for k in values}
+        return {k: changed_tile_map(self._last_values[k], v, plan)
+                for k, v in values.items()}
+
+    # -- restore ------------------------------------------------------------
+
+    def _keyframes_on_disk(self) -> list[int]:
+        """Steps of the self-contained keyframe files present — the
+        degraded (manifest-less) chain view."""
+        out = []
+        prefix = self.prefix + "_"
+        if not os.path.isdir(self.directory):
+            return out
+        for fn in os.listdir(self.directory):
+            if fn.startswith(prefix) and fn.endswith(SUFFIX_KEYFRAME):
+                try:
+                    out.append(int(fn[len(prefix):-len(SUFFIX_KEYFRAME)]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def steps(self) -> list[int]:
+        """Committed (manifested) steps; with the manifest missing or
+        unreadable, the self-contained keyframes found on disk. Cached
+        against the manifest's stat signature — ``latest()`` probes
+        every step through here and must not re-read the manifest per
+        probe."""
+        sig = None
+        try:
+            st = os.stat(self.manifest_path)
+            sig = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            pass
+        if sig is not None and self._steps_cache is not None \
+                and self._steps_cache[0] == sig:
+            return list(self._steps_cache[1])
+        records, _ = self._manifest()
+        if records is not None:
+            out = sorted(r["step"] for r in records)
+            if sig is not None:
+                self._steps_cache = (sig, list(out))
+            return out
+        return self._keyframes_on_disk()
+
+    def has_step(self, step: int) -> bool:
+        return int(step) in self.steps()
+
+    def restore(self, step: int) -> Checkpoint:
+        """Replay the chain up to ``step``: nearest keyframe at-or-
+        before it, then each delta in base-link order, every piece
+        CRC-verified. Raises ``CheckpointCorruptionError`` on any torn,
+        CRC-failing or missing record in the segment (the manager's
+        ``latest()`` then falls back — truncating the chain at the last
+        verified record) and ``FileNotFoundError`` for a step the chain
+        never committed."""
+        step = int(step)
+        records, merr = self._manifest()
+        if records is None:
+            # degraded: only self-contained keyframes can be trusted
+            kfp = self.record_path(step, "keyframe")
+            if os.path.exists(kfp):
+                if merr is not None:
+                    warnings.warn(
+                        f"chain manifest {self.manifest_path} is "
+                        f"unreadable ({merr}); restoring the self-"
+                        "contained keyframe without delta replay",
+                        RuntimeWarning, stacklevel=3)
+                return self._restore_segment(
+                    [({"kind": "keyframe", "step": step, "base": None},
+                      kfp)])
+            if merr is not None:
+                raise CheckpointCorruptionError(
+                    f"chain manifest {self.manifest_path} is unreadable "
+                    f"({merr}) and step {step} is not a keyframe — its "
+                    "delta records cannot be validated")
+            raise FileNotFoundError(
+                f"no delta-chain record for step {step} in "
+                f"{self.directory}")
+        idx = next((i for i, r in enumerate(records)
+                    if r["step"] == step), None)
+        if idx is None:
+            raise FileNotFoundError(
+                f"no delta-chain record for step {step} in "
+                f"{self.directory}")
+        seg = [records[idx]]
+        while seg[0]["kind"] != "keyframe":
+            if idx == 0:
+                raise CheckpointCorruptionError(
+                    f"chain record for step {seg[0]['step']} has no "
+                    "keyframe ancestor in the manifest")
+            prev = records[idx - 1]
+            if seg[0].get("base") != prev["step"]:
+                raise CheckpointCorruptionError(
+                    f"chain link broken at step {seg[0]['step']}: its "
+                    f"base is {seg[0].get('base')} but the previous "
+                    f"manifested record is step {prev['step']}")
+            idx -= 1
+            seg.insert(0, prev)
+        return self._restore_segment(
+            [(r, os.path.join(self.directory, r["file"])) for r in seg])
+
+    def _restore_segment(self, seg: list) -> Checkpoint:
+        arrays: Optional[dict] = None
+        meta: Optional[dict] = None
+        for rec, path in seg:
+            kind = rec["kind"]
+            rd = _RecordReader(path)
+            try:
+                meta = rd.meta
+                # EVERY record's identity must match its manifest entry
+                # (kind, step, base) — a swapped/mixed-up record file of
+                # the right kind would otherwise replay wrong-interval
+                # tiles with every per-piece CRC passing
+                if (meta["kind"] != kind
+                        or int(meta["step"]) != int(rec["step"])
+                        or meta.get("base") != rec.get("base")):
+                    raise CheckpointCorruptionError(
+                        f"chain record {path} does not match its "
+                        f"manifest entry (kind/step/base drift: file "
+                        f"says {meta['kind']}@{meta['step']} base "
+                        f"{meta.get('base')}, manifest says "
+                        f"{kind}@{rec['step']} base {rec.get('base')})")
+                if kind == "keyframe":
+                    arrays = _new_arrays(meta["channels"])
+                    covered = {k: False for k in arrays}
+                    for piece in meta["pieces"]:
+                        if list(piece["shape"]) != list(
+                                meta["channels"][piece["channel"]]
+                                ["shape"]):
+                            raise CheckpointCorruptionError(
+                                f"keyframe {path}: piece for channel "
+                                f"{piece['channel']!r} does not cover "
+                                "it whole")
+                        covered[piece["channel"]] = True
+                    if not all(covered.values()):
+                        raise CheckpointCorruptionError(
+                            f"keyframe {path} is missing channel "
+                            "pieces: "
+                            f"{[k for k, v in covered.items() if not v]}")
+                    _apply_pieces(arrays, meta, rd.raw, path)
+                else:
+                    _apply_pieces(arrays, meta, rd.raw, path)
+            finally:
+                rd.close()
+        values = {k: jnp.asarray(v) for k, v in arrays.items()}
+        space = CellularSpace(
+            values, meta["dim_x"], meta["dim_y"], meta["x_init"],
+            meta["y_init"], meta["global_dim_x"], meta["global_dim_y"])
+        # seed the writer: a save right after this restore may continue
+        # the chain with a delta instead of forcing a keyframe (save()
+        # retracts any records past this step first, so the seed can
+        # never describe a different timeline)
+        self._last_values = arrays
+        self._last_step = int(meta["step"])
+        return Checkpoint(space=space, step=int(meta["step"]),
+                          extra=meta.get("extra", {}))
+
+    # -- retention ----------------------------------------------------------
+
+    def prune(self, keep: int) -> None:
+        """Keep the newest ``keep`` records WITHOUT ever breaking a live
+        segment: the cut only lands on a keyframe boundary, so a
+        keyframe that retained deltas still replay from is never
+        deleted (the cut moves older — retention errs toward keeping
+        more, never toward an unrestorable chain)."""
+        records, merr = self._manifest()
+        if records is None or merr is not None or keep <= 0:
+            return
+        cut = max(0, len(records) - int(keep))
+        while cut > 0 and records[cut]["kind"] != "keyframe":
+            cut -= 1
+        live = records[cut:]
+        if cut > 0:
+            self._write_manifest(live)
+            for r in records[:cut]:
+                p = os.path.join(self.directory, r["file"])
+                if os.path.exists(p):
+                    os.unlink(p)
+        # orphan sweep: record files not referenced by the manifest are
+        # retracted/overwritten leftovers
+        referenced = {r["file"] for r in live}
+        prefix = self.prefix + "_"
+        for fn in os.listdir(self.directory):
+            if (fn.startswith(prefix)
+                    and (fn.endswith(SUFFIX_KEYFRAME)
+                         or fn.endswith(SUFFIX_DELTA))
+                    and fn not in referenced):
+                os.unlink(os.path.join(self.directory, fn))
+
+
+# -- live migration ----------------------------------------------------------
+
+def _verified_clone(values: dict[str, np.ndarray], where: str
+                    ) -> dict[str, np.ndarray]:
+    """Round one state through the record wire format (full pieces +
+    CRC32 per piece) and return the materialized copy — the CRC-verified
+    handoff both migration entry points share."""
+    pieces, payload = _full_pieces(values)
+    meta = {"channels": _channels_meta(values), "pieces": pieces}
+    arrays = _new_arrays(meta["channels"])
+    _apply_pieces(arrays, meta, lambda key: payload[key], where)
+    return arrays
+
+
+def transfer_space(space: CellularSpace) -> CellularSpace:
+    """One-shot (keyframe-only) handoff of a scenario's state through
+    the delta-stream wire format, CRC-verified — what the ensemble
+    scheduler's ``migrate_ticket`` drains a queued scenario through."""
+    values = {k: np.ascontiguousarray(v) for k, v in space.values.items()}
+    arrays = _verified_clone(values, "migration keyframe")
+    return dataclasses.replace(
+        space, values={k: jnp.asarray(v) for k, v in arrays.items()})
+
+
+@dataclasses.dataclass
+class MigrationResult:
+    """What a live handoff produced: the final state (after the
+    remaining steps ran on the target), provenance, and the stream
+    accounting that makes the 'doesn't stop the world' claim checkable
+    — the cutover payload is ``delta_bytes``, not ``keyframe_bytes``."""
+
+    space: CellularSpace
+    step: int
+    handoff_step: int
+    keyframe_bytes: int
+    delta_bytes: int
+    dirty_tiles: int
+    ntiles: int
+    report: Optional[object] = None
+
+
+def migrate_scenario(model, space: CellularSpace, *, source=None,
+                     target=None, steps: Optional[int] = None,
+                     handoff_at: Optional[int] = None,
+                     transfer_steps: int = 0,
+                     tile: Optional[tuple[int, int]] = None,
+                     verify: bool = True) -> MigrationResult:
+    """Move a LIVE scenario from ``source`` to ``target`` executor
+    mid-run via the delta stream (serial ↔ sharded, any executor pair
+    that steps bitwise-identically).
+
+    Protocol: run ``handoff_at`` steps on the source; snapshot the
+    keyframe (the bulk copy — while it is "in flight" the source keeps
+    running ``transfer_steps`` more steps); at cutover ship only the
+    dirty-tile delta between the source's current state and the
+    keyframe; materialize keyframe+delta on the target side (every
+    piece CRC-verified) and — with ``verify`` (default) — check the
+    materialized state is BITWISE equal to the source's before
+    resuming; then run the remaining steps on the target. A mismatch
+    raises ``MigrationError`` and the source state is untouched.
+
+    Returns a ``MigrationResult`` whose ``space`` equals an
+    uninterrupted ``steps``-step run bitwise (tested for serial ↔
+    sharded both ways)."""
+    steps = model.num_steps if steps is None else int(steps)
+    if handoff_at is None:
+        handoff_at = steps // 2
+    handoff_at = int(handoff_at)
+    transfer_steps = int(transfer_steps)
+    if not 0 <= handoff_at <= steps:
+        raise ValueError(
+            f"handoff_at={handoff_at} outside [0, steps={steps}]")
+    if transfer_steps < 0 or handoff_at + transfer_steps > steps:
+        raise ValueError(
+            f"transfer_steps={transfer_steps} overruns the run: "
+            f"handoff_at + transfer_steps must be <= steps={steps}")
+
+    from ..parallel.multihost import gather_global
+
+    def host(sp):
+        return {k: np.ascontiguousarray(gather_global(v))
+                for k, v in sp.values.items()}
+
+    live = space
+    if handoff_at > 0:
+        live, _ = model.execute(live, source, steps=handoff_at,
+                                check_conservation=False)
+    # the bulk copy: keyframe snapshot at the handoff point
+    kf_values = host(live)
+    kf_pieces, kf_payload = _full_pieces(kf_values)
+    keyframe_bytes = sum(p.nbytes for p in kf_payload.values())
+
+    # the source keeps the scenario live while the keyframe transfers
+    cutover_step = handoff_at + transfer_steps
+    if transfer_steps > 0:
+        live, _ = model.execute(live, source, steps=transfer_steps,
+                                check_conservation=False)
+    cur_values = host(live)
+
+    # cutover: only the tiles that changed while the copy was in flight
+    # (plan follows the GATHERED arrays — live.shape is the local
+    # partition under jax.distributed, the host() values are global)
+    plan = plan_for(next(iter(cur_values.values())).shape, tile=tile)
+    dirty = {k: changed_tile_map(kf_values[k], cur_values[k], plan)
+             for k in cur_values}
+    d_pieces, d_payload = _tile_pieces(cur_values, plan, dirty)
+    delta_bytes = sum(p.nbytes for p in d_payload.values())
+    ndirty = int(sum(int(m.sum()) for m in dirty.values()))
+
+    # target side: keyframe + delta replay, every piece CRC-verified
+    channels = _channels_meta(kf_values)
+    arrays = _new_arrays(channels)
+    _apply_pieces(arrays, {"channels": channels, "pieces": kf_pieces},
+                  lambda key: kf_payload[key], "migration keyframe")
+    _apply_pieces(arrays, {"channels": channels, "pieces": d_pieces},
+                  lambda key: d_payload[key], "migration delta")
+    if verify:
+        for k, src in cur_values.items():
+            if not np.array_equal(src.view(np.uint8),
+                                  arrays[k].view(np.uint8)):
+                raise MigrationError(
+                    f"migrated state for channel {k!r} is not bitwise "
+                    "equal to the source at cutover — handoff aborted, "
+                    "the scenario stays on the source")
+    tspace = dataclasses.replace(
+        live, values={k: jnp.asarray(v) for k, v in arrays.items()})
+
+    remaining = steps - cutover_step
+    report = None
+    out = tspace
+    if remaining > 0:
+        out, report = model.execute(tspace, target, steps=remaining,
+                                    check_conservation=False)
+    return MigrationResult(
+        space=out, step=steps, handoff_step=cutover_step,
+        keyframe_bytes=keyframe_bytes, delta_bytes=delta_bytes,
+        dirty_tiles=ndirty, ntiles=plan.ntiles * len(cur_values),
+        report=report)

@@ -140,6 +140,14 @@ class SerialExecutor:
         #: per-run report detail (Report.backend_report); None until a
         #: run records one
         self.last_backend_report: Optional[dict] = None
+        #: dirty-tile export of the last ACTIVE run (ISSUE 7): a dict
+        #: {"tile", "grid", "map"} whose bool [gi, gj] "map" is the
+        #: union of every tile the run wrote — the activity-sourced
+        #: dirtiness the delta checkpoint layer (io.delta) consumes
+        #: instead of diffing the full grid. None after any run that
+        #: cannot vouch for it (dense/composed/point paths, a poisoned
+        #: chunk), which makes the consumer fall back to the diff.
+        self.last_dirty_tiles: Optional[dict] = None
         self._cache: dict = {}
 
     def run_model(self, model: "Model", space: CellularSpace,
@@ -162,6 +170,9 @@ class SerialExecutor:
         out = self._run_inner(model, space, num_steps)
         if fault is not None:  # kind == "nan": poison the chunk OUTPUT
             out = inject.poison_values(out, fault, st.plan)
+            # the poison wrote outside the engine's tracked set: the
+            # dirty export no longer covers this output
+            self.last_dirty_tiles = None
         return out
 
     def _run_inner(self, model: "Model", space: CellularSpace,
@@ -169,6 +180,9 @@ class SerialExecutor:
         #: per-run report detail (Report.backend_report) — reset so a
         #: previous run's composed/active record never leaks forward
         self.last_backend_report = None
+        # likewise the dirty-tile export: a stale map from a previous
+        # active run must never describe THIS run's output
+        self.last_dirty_tiles = None
         # all-point-flow models step only the ≤9k involved cells in the
         # compiled loop (one O(grid) gather/scatter per RUN, bitwise
         # equal to the full-grid path) — the reference's live workload
@@ -251,13 +265,20 @@ class SerialExecutor:
                         space.shape, live, model.offsets, space.dtype,
                         origin=(space.x_init, space.y_init),
                         global_shape=space.global_shape, plan=plan,
-                        dense_fns=dense_fns))
+                        dense_fns=dense_fns, track_dirty=True))
                     entry = (run, plan)
                     self._cache[key] = entry
                 run, plan = entry
-                out, (fb, at) = run(dict(space.values),
-                                    jnp.int32(num_steps))
+                out, (fb, at, dirty) = run(dict(space.values),
+                                           jnp.int32(num_steps))
                 self.last_impl = "active"
+                # dirty-tile export (ISSUE 7): the union of tiles this
+                # run wrote, for the delta checkpoint layer — [gi, gj]
+                # of bools, a few KB even at the bench geometry
+                self.last_dirty_tiles = {
+                    "tile": plan.tile, "grid": plan.grid,
+                    "map": np.asarray(dirty),
+                }
                 nattr = len(live)
                 self.last_backend_report = {
                     "impl": "active",

@@ -196,6 +196,21 @@ def ghost_flags(padded: jax.Array, plan: ActivePlan) -> jax.Array:
     return flags
 
 
+def changed_tile_map(prev, new, plan: ActivePlan) -> np.ndarray:
+    """Per-tile any-CHANGED map between two states of one channel: bool
+    ``[gi, gj]`` host array, True where any byte of the tile differs.
+    The delta-checkpoint writer's fallback dirtiness source for dense/
+    composed runs (``io.delta``): one vectorized compare over the grid,
+    no state carried. Compares raw bytes, not values — a ``-0.0`` vs
+    ``+0.0`` flip or a NaN cell reads as changed (NaN != NaN would too,
+    but byte compare keeps the map deterministic for any payload), so a
+    skipped tile is bit-identical by construction."""
+    (th, tw), (gi, gj) = plan.tile, plan.grid
+    a = np.ascontiguousarray(prev).view(np.uint8).reshape(gi, th, gj, -1)
+    b = np.ascontiguousarray(new).view(np.uint8).reshape(gi, th, gj, -1)
+    return np.any(a != b, axis=(1, 3))
+
+
 def compact_tile_ids(flags: jax.Array,
                      plan: ActivePlan) -> tuple[jax.Array, jax.Array]:
     """Cumsum-compact the active map into the fixed ``[K]`` index buffer:
@@ -417,9 +432,20 @@ def build_active_runner(shape: tuple[int, int], rates: dict,
                         global_shape: Optional[tuple[int, int]] = None,
                         plan: Optional[ActivePlan] = None,
                         dense_fns: Optional[dict] = None,
-                        traced_rates: bool = False) -> Callable:
+                        traced_rates: bool = False,
+                        track_dirty: bool = False) -> Callable:
     """Whole-run active stepper: ``run(values, n[, rates_vec]) ->
-    (values, (fallback_events, active_tiles_total))``.
+    (values, (fallback_events, active_tiles_total))`` — or, with
+    ``track_dirty=True``, ``(values, (fallback_events,
+    active_tiles_total, dirty_map))`` where ``dirty_map`` is the bool
+    ``[gi, gj]`` UNION over the whole run of every tile the engine
+    wrote: the compacted active set on active steps (exactly the tiles
+    the scatter touched), the ring-1 dilation of the pre-step nonzero
+    map on dense-fallback steps (a dense Diffusion step can only change
+    cells within distance 1 of pre-step mass). A guaranteed superset of
+    the tiles whose bytes changed — the dirty-tile export the
+    incremental checkpoint layer (``io.delta``) keys its delta records
+    off, costing one [gi, gj] bool OR per step.
 
     Pads each flow channel ONCE, then carries ``(padded, tile_map,
     update_buffer)`` per channel across all ``n`` steps (a traced trip
@@ -478,34 +504,41 @@ def build_active_runner(shape: tuple[int, int], rates: dict,
                                         dtype)
         fb = jnp.zeros((), jnp.int32)
         at = jnp.zeros((), jnp.float32)
+        # dirty union rides the carries ONLY when tracked, so a
+        # track_dirty=False build (the ensemble lanes) stays
+        # program-identical to a pre-export build
+        dm = (jnp.zeros(plan.grid, bool),) if track_dirty else ()
         out = dict(values)
         for a in attrs:
             rate = rate_of(a, rates_vec)
 
-            # carry: (padded, tile_map, upd, steps_done, fb, at)
+            # carry: (padded, tile_map, upd, steps_done, fb, at[, dirty])
             def inner_cond(c, _n=n):
                 _, cnt = _dilated_count(c[1])
                 return (c[3] < _n) & (cnt <= thresh)
 
             def inner_body(c, _rate=rate):
-                p, tm, u, i, fb_, at_ = c
+                p, tm, u, i, fb_, at_, *dm_ = c
                 flags, cnt = _dilated_count(tm)
                 ids, _ = compact_tile_ids(flags, plan)
                 p2, u2, anyf = active_pass(p, u, ids, cnt, _rate, plan,
                                            origin, gshape, offsets, dtype)
+                if track_dirty:
+                    # the scatter wrote exactly the flagged tiles
+                    dm_ = (dm_[0] | flags,)
                 return (p2, next_tile_map(anyf, ids, cnt, plan), u2,
-                        i + 1, fb_, at_ + cnt.astype(jnp.float32))
+                        i + 1, fb_, at_ + cnt.astype(jnp.float32), *dm_)
 
             def outer_body(c, _a=a, _rate=rate, _n=n):
                 c = lax.while_loop(inner_cond, inner_body, c)
-                p, tm, u, i, fb_, at_ = c
+                p, tm, u, i, fb_, at_, *dm_ = c
 
                 # the inner loop exited: either the run is done, or this
                 # step's dilated count crossed the threshold — run the
                 # DENSE step for it (one cond per fallback EVENT, so the
                 # buffer-copy tax never lands on the active fast path)
                 def dense_step(args):
-                    pp, tm_, i_, fb__, at__ = args
+                    pp, tm_, i_, fb__, at__, *dm__ = args
                     _, cnt = _dilated_count(tm_)
                     fn = dense_fns.get(_a)
                     if fn is not None:
@@ -513,22 +546,30 @@ def build_active_runner(shape: tuple[int, int], rates: dict,
                     else:
                         p2 = dense_from_padded(pp, _rate, counts, offsets,
                                                dtype)
+                    if track_dirty:
+                        # a dense Diffusion step changes cells only
+                        # within distance 1 of pre-step mass: the ring-1
+                        # tile dilation of the pre-step map bounds them
+                        dm__ = (dm__[0] | dilate_tile_map(tm_),)
                     return (p2, tile_nonzero_map(p2[1:-1, 1:-1], plan),
                             i_ + 1, fb__ + 1,
-                            at__ + cnt.astype(jnp.float32))
+                            at__ + cnt.astype(jnp.float32), *dm__)
 
-                p, tm, i, fb_, at_ = lax.cond(
+                p, tm, i, fb_, at_, *dm_ = lax.cond(
                     i < _n, dense_step, lambda args: args,
-                    (p, tm, i, fb_, at_))
-                return p, tm, u, i, fb_, at_
+                    (p, tm, i, fb_, at_, *dm_))
+                return (p, tm, u, i, fb_, at_, *dm_)
 
             c = lax.while_loop(
                 lambda c, _n=n: c[3] < _n, outer_body,
                 (jnp.pad(values[a], 1), tile_nonzero_map(values[a], plan),
                  jnp.zeros((plan.capacity, th, tw), dtype),
-                 jnp.zeros((), jnp.int32), fb, at))
-            padded, _, _, _, fb, at = c
+                 jnp.zeros((), jnp.int32), fb, at, *dm))
+            padded, _, _, _, fb, at, *dm = c
             out[a] = padded[1:-1, 1:-1]
+            dm = tuple(dm)
+        if track_dirty:
+            return out, (fb, at, dm[0])
         return out, (fb, at)
 
     return run

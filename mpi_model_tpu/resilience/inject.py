@@ -319,10 +319,15 @@ def poison_lane_values(values_b: dict, lane: int, fault: Fault) -> dict:
 
 def checkpoint_torn(path: str, step: int, part: str = "data") -> None:
     """Checkpoint-writer seam: tear/corrupt the just-written file when a
-    ``torn`` fault is armed for this step. ``part`` distinguishes the
-    sharded format's shard files ("data") from its manifest — a fault
-    pins the part via its ``channel`` field ("manifest" to tear the
-    commit record itself)."""
+    ``torn`` fault is armed for this step. ``part`` names what was
+    written — "data" (a dense ``.npz`` / sharded shard file),
+    "manifest" (the sharded commit record), or the delta layout's
+    "keyframe" / "delta" records and "chain" manifest — and a fault
+    pins its target via the ``channel`` field. An unpinned fault
+    (``channel=None``, the "data" default) matches any DATA part
+    (dense, shard, keyframe, delta), so one plan drives every layout;
+    the commit records ("manifest", "chain") must be named
+    explicitly."""
     st = _ACTIVE
     if st is None:
         return
@@ -332,7 +337,8 @@ def checkpoint_torn(path: str, step: int, part: str = "data") -> None:
         if f.at is not None and f.at != step:
             continue
         want_part = f.channel or "data"
-        if want_part != part:
+        if want_part != part and not (
+                want_part == "data" and part in ("keyframe", "delta")):
             continue
         st._fire(i, f)
         tear_file(path, f.offset, f.nbytes, f.tear)

@@ -406,8 +406,17 @@ def _supervise_loop(model, space, manager, total, every, max_failures,
         last_sig = None
         good_space, good_step = out_space, good_step + n
         if manager is not None:
+            kw = {}
+            if getattr(manager, "layout", None) == "delta":
+                # the active executor's dirty-tile export covers exactly
+                # this chunk (= the interval since the last save), so a
+                # delta snapshot skips the full-grid diff; None (dense/
+                # composed impls, a poisoned chunk) falls back to the
+                # writer's byte diff
+                kw["dirty_tiles"] = getattr(executor, "last_dirty_tiles",
+                                            None)
             manager.save(good_space, good_step,
-                         extra={"initial_totals": initial})
+                         extra={"initial_totals": initial}, **kw)
 
     return SupervisedResult(space=good_space, step=good_step,
                             report=report, events=events,

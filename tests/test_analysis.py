@@ -479,6 +479,36 @@ def test_naked_save_negative_non_checkpoint_saves():
     assert rules_of(lint_source(src2, "tests/test_fake.py")) == []
 
 
+def test_naked_save_flags_delta_chain_writers():
+    """ISSUE 7: the delta chain's raw record writer and a DeltaChain
+    receiver's .save are checkpoint writes too — outside the io/
+    resilience boundaries they bypass the chain-manifest commit
+    discipline exactly like a raw save_checkpoint bypasses the CRCs."""
+    src = ("from mpi_model_tpu.io.delta import write_chain_record\n"
+           "def f(meta, payload, chain, space):\n"
+           "    write_chain_record('x.kf.npz', meta, payload)\n"
+           "    chain.save(space, 3)\n"
+           "    self_chain = chain\n")
+    assert rules_of(lint_source(src, PKG)) == ["naked-save", "naked-save"]
+    # a chain stored on an attribute rides the same receiver rule
+    src2 = ("class S:\n"
+            "    def f(self, space):\n"
+            "        self.chain.save(space, 3)\n")
+    assert rules_of(lint_source(src2, PKG)) == ["naked-save"]
+
+
+def test_naked_save_delta_module_is_a_boundary():
+    src = ("def f(meta, payload, chain, space):\n"
+           "    write_chain_record('x.kf.npz', meta, payload)\n"
+           "    chain.save(space, 3)\n")
+    assert rules_of(lint_source(src, "mpi_model_tpu/io/delta.py")) == []
+    # encoding helpers are pure (no I/O) and not writer names
+    src3 = ("from mpi_model_tpu.io.delta import transfer_space\n"
+            "def g(space):\n"
+            "    return transfer_space(space)\n")
+    assert rules_of(lint_source(src3, PKG)) == []
+
+
 def test_naked_save_pragma_suppresses_with_reason():
     src = ("def f(mgr, space):\n"
            "    # analysis: ignore[naked-save] — bootstrap write before\n"

@@ -9,6 +9,7 @@ All faults here are in-memory / on-local-disk (no subprocesses), so the
 matrix runs inside the tier-1 inner loop as the chaos smoke."""
 
 import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -302,6 +303,213 @@ def test_explicit_restore_of_corrupt_step_propagates(tmp_path):
         assert mgr.latest().step == 2
     with pytest.raises(CheckpointCorruptionError):
         mgr.restore(4)
+
+
+# -- delta-chain chaos (ISSUE 7): torn records, torn chain, replay -----------
+
+def _sparse_space(g=48):
+    """Zero ocean + a small deterministic block: the sparse state whose
+    chain actually holds DELTA records (a dense state degrades every
+    delta to a keyframe and the delta seams never fire)."""
+    v = np.zeros((g, g))
+    v[4:8, 4:8] = RNG_BASE[:4, :4]
+    return CellularSpace.create(g, g, 0.0, dtype=jnp.float64).with_values(
+        {"value": jnp.asarray(v, jnp.float64)})
+
+
+def _delta_mgr(path, keyframe_every=8):
+    return CheckpointManager(str(path), keep=100, layout="delta",
+                             keyframe_every=keyframe_every,
+                             delta_tile=(8, 8))
+
+
+def _active_ex():
+    return SerialExecutor(step_impl="active", active_opts={"tile": (8, 8)})
+
+
+def _sparse_final(model, steps=8):
+    out, _ = model.execute(_sparse_space(), steps=steps)
+    return np.asarray(out.values["value"])
+
+
+def test_torn_delta_record_resume_falls_back_bitwise(tmp_path):
+    """A torn tail DELTA truncates the chain at the last verified
+    record; the resumed run recomputes from there and finishes
+    bitwise."""
+    model = make_model()
+    want = _sparse_final(model)
+    mgr = _delta_mgr(tmp_path)
+    plan = FaultPlan((Fault("torn", at=8, channel="delta",
+                            tear="truncate", offset=64),))
+    with inject.armed(plan) as st:
+        supervised_run(model, _sparse_space(), mgr, steps=8, every=2,
+                       executor=_active_ex())
+    assert [f["kind"] for f in st.fired] == ["torn"]
+    mgr2 = _delta_mgr(tmp_path)
+    with pytest.warns(RuntimeWarning, match="failed verification"):
+        res = supervised_run(model, _sparse_space(), mgr2, steps=8,
+                             every=2, executor=_active_ex())
+    assert res.step == 8
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]), want)
+
+
+def test_torn_keyframe_resume_falls_back_bitwise(tmp_path):
+    """A torn KEYFRAME invalidates itself; latest() falls back to the
+    previous verified record (the prior segment's tail delta)."""
+    model = make_model()
+    want = _sparse_final(model)
+    # keyframe_every=2 puts a keyframe at step 8 (kf0 d2 | kf4 d6 | kf8)
+    mgr = _delta_mgr(tmp_path, keyframe_every=2)
+    plan = FaultPlan((Fault("torn", at=8, channel="keyframe",
+                            tear="corrupt", offset=200),))
+    with inject.armed(plan) as st:
+        supervised_run(model, _sparse_space(), mgr, steps=8, every=2,
+                       executor=_active_ex())
+    assert [f["kind"] for f in st.fired] == ["torn"]
+    mgr2 = _delta_mgr(tmp_path, keyframe_every=2)
+    with pytest.warns(RuntimeWarning, match="failed verification"):
+        res = supervised_run(model, _sparse_space(), mgr2, steps=8,
+                             every=2, executor=_active_ex())
+    assert res.step == 8
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]), want)
+
+
+def test_crc_mismatched_delta_piece_detected(tmp_path):
+    """Bit rot inside a delta's payload (past the zip headers) fails a
+    CRC — zip-member or per-piece, whichever sees it first — and resume
+    lands on the previous verified step."""
+    model = make_model()
+    mgr = _delta_mgr(tmp_path)
+    supervised_run(model, _sparse_space(), mgr, steps=8, every=2,
+                   executor=_active_ex())
+    inject.tear_file(str(tmp_path / "ckpt_0000000008.d.npz"),
+                     offset=300, nbytes=16, tear="corrupt")
+    mgr2 = _delta_mgr(tmp_path)
+    with pytest.raises(CheckpointCorruptionError):
+        mgr2.restore(8)
+    with pytest.warns(RuntimeWarning, match="failed verification"):
+        ck = mgr2.latest()
+    assert ck.step == 6
+    want6, _ = model.execute(_sparse_space(), steps=6)
+    np.testing.assert_array_equal(np.asarray(ck.space.values["value"]),
+                                  np.asarray(want6.values["value"]))
+
+
+def test_torn_chain_manifest_degrades_to_keyframes(tmp_path):
+    """An unreadable chain manifest means delta records cannot be
+    validated: recovery degrades (loudly) to the newest self-contained
+    keyframe — never a silent fresh start."""
+    model = make_model()
+    mgr = _delta_mgr(tmp_path, keyframe_every=4)  # kf0 d2 d4 d6 | kf8
+    plan = FaultPlan((Fault("torn", at=8, channel="chain",
+                            tear="corrupt", offset=2),))
+    with inject.armed(plan) as st:
+        supervised_run(model, _sparse_space(), mgr, steps=8, every=2,
+                       executor=_active_ex())
+    assert [f["kind"] for f in st.fired] == ["torn"]
+    mgr2 = _delta_mgr(tmp_path, keyframe_every=4)
+    assert mgr2.steps() == [0, 8]  # keyframes only — deltas untrusted
+    with pytest.warns(RuntimeWarning, match="unreadable"):
+        ck = mgr2.latest()
+    assert ck.step == 8
+    np.testing.assert_array_equal(np.asarray(ck.space.values["value"]),
+                                  _sparse_final(model))
+
+
+def test_delta_all_records_corrupt_fails_fast(tmp_path):
+    """Every record damaged: latest() must raise (resuming from nothing
+    would silently discard the run's durable history), exactly like the
+    dense layout's contract."""
+    mgr = _delta_mgr(tmp_path, keyframe_every=1)
+    mgr.save(_sparse_space(), 2)
+    mgr.save(_sparse_space(), 4)
+    for fn in os.listdir(tmp_path):
+        if fn.endswith(".npz"):
+            inject.tear_file(str(tmp_path / fn), offset=0, tear="truncate")
+    mgr2 = _delta_mgr(tmp_path, keyframe_every=1)
+    with pytest.warns(RuntimeWarning, match="failed verification"):
+        with pytest.raises(CheckpointCorruptionError,
+                           match="no verifiable checkpoint"):
+            mgr2.latest()
+
+
+def test_delta_layout_heals_injected_executor_fault(tmp_path):
+    """The PR 5 self-healing loop with the cheap layout underneath it:
+    an injected executor fault rolls back onto a DELTA-chain restore
+    and the run still finishes bitwise — serial and sharded."""
+    from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh
+
+    model = make_model()
+    want_serial = _sparse_final(model)
+    plan = FaultPlan((Fault("exc", at=2),))
+    mgr = _delta_mgr(tmp_path / "serial")
+    with inject.armed(plan):
+        res = supervised_run(model, _sparse_space(), mgr, steps=8,
+                             every=2, executor=_active_ex())
+    assert len(res.events) == 1
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]), want_serial)
+
+    ex = ShardMapExecutor(make_mesh(4))
+    want_sharded, _ = model.execute(_sparse_space(), ex, steps=8)
+    mgr2 = _delta_mgr(tmp_path / "sharded")
+    with inject.armed(FaultPlan((Fault("exc", at=2),))):
+        res2 = supervised_run(model, _sparse_space(), mgr2, steps=8,
+                              every=2, executor=ShardMapExecutor(
+                                  make_mesh(4)))
+    assert len(res2.events) == 1
+    np.testing.assert_array_equal(
+        np.asarray(res2.space.values["value"]),
+        np.asarray(want_sharded.values["value"]))
+
+
+def test_migration_unaffected_by_armed_foreign_chaos():
+    """The migration paths stay bitwise with a FaultPlan armed for
+    OTHER seams (the zero-overhead contract: seams not matching never
+    perturb) — the 'chaos matrix passes with migration armed' leg."""
+    from mpi_model_tpu.io import migrate_scenario
+
+    model = make_model()
+    want = _sparse_final(model)
+    plan = FaultPlan((Fault("torn", at=999), Fault("lane_nan", lane=7)))
+    with inject.armed(plan) as st:
+        res = migrate_scenario(
+            model, _sparse_space(), source=SerialExecutor(),
+            target=_active_ex(), steps=8, handoff_at=3,
+            transfer_steps=2, tile=(8, 8))
+    assert st.fired == []
+    np.testing.assert_array_equal(
+        np.asarray(res.space.values["value"]), want)
+
+
+def test_scheduler_migration_with_chaos_on_target():
+    """A scenario migrated onto a target scheduler whose dispatch is
+    chaos-faulted still heals through the target's solo-retry path —
+    migration composes with the PR 5 recovery ladder."""
+    from mpi_model_tpu.ensemble import EnsembleScheduler
+
+    model = make_model(4.0)
+    src = EnsembleScheduler(max_batch=8)
+    tgt = EnsembleScheduler(max_batch=2, retry="solo")
+    t = src.submit(_sparse_space(), model, steps=4)
+    plan = FaultPlan((Fault("lane_nan", ticket=0, once=True),))
+    with inject.armed(plan):
+        nt = src.migrate_ticket(t, tgt)
+        assert nt == 0  # the target's first ticket — the fault's target
+        # a same-structure batchmate: submit() completes the batch of 2
+        # and dispatches, so the poisoned lane fails IN a batch and the
+        # solo retry can prove the scenario itself is healthy
+        other = tgt.submit(_sparse_space(), model, steps=4)
+        res = tgt.poll(nt)
+        assert tgt.poll(other) is not None  # batchmate undisturbed
+    assert res is not None
+    want, _ = model.execute(_sparse_space(), SerialExecutor(), steps=4)
+    np.testing.assert_array_equal(np.asarray(res[0].values["value"]),
+                                  np.asarray(want.values["value"]))
+    st = tgt.stats()
+    assert st["recovered_failures"] == 1 and st["migrated_in"] == 1
 
 
 # -- resume-time edge cases (ISSUE 5 satellite) -------------------------------

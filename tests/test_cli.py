@@ -301,3 +301,76 @@ def test_cli_rectangular_gspmd_rejected_clearly():
     bounce the user between 'add --mesh' and 'drop --mesh'."""
     with pytest.raises(SystemExit, match="ShardMapExecutor"):
         cli.main(["run", "--rectangular=2x3", "--executor=gspmd"])
+
+
+# -- delta-layout surface (ISSUE 7) ------------------------------------------
+
+def test_cli_delta_checkpointed_run_and_resume(tmp_path, capsys):
+    """--checkpoint-layout=delta end-to-end: a supervised run writes a
+    chain (manifest + records), and a rerun resumes from it."""
+    d = str(tmp_path / "ck")
+    args = ["run", "--flow=diffusion", "--dimx=16", "--dimy=16",
+            "--checkpoint-every=2", f"--checkpoint-dir={d}",
+            "--checkpoint-layout=delta", "--keyframe-every=3",
+            "--dtype=float64", "--json"]
+    rc = cli.main(args + ["--steps=4"])
+    out = capsys.readouterr().out
+    assert rc == 0 and json.loads(out)["conserved"] is True
+    assert "ckpt_chain.json" in os.listdir(d)
+    rc = cli.main(args + ["--steps=8"])
+    row = json.loads(capsys.readouterr().out)
+    assert rc == 0 and row["steps"] == 8 and row["conserved"] is True
+
+
+def test_cli_delta_layout_requires_dir():
+    with pytest.raises(SystemExit, match="checkpoint-dir"):
+        cli.main(["run", "--dimx=8", "--dimy=8",
+                  "--checkpoint-layout=delta"])
+
+
+def test_cli_keyframe_every_validation(tmp_path):
+    # --keyframe-every without the delta layout is a no-op the user
+    # must not believe configured anything
+    with pytest.raises(SystemExit, match="keyframe"):
+        cli.main(["run", "--dimx=8", "--dimy=8",
+                  f"--checkpoint-dir={tmp_path}", "--keyframe-every=4"])
+    with pytest.raises(SystemExit, match=">= 1"):
+        cli.main(["run", "--dimx=8", "--dimy=8",
+                  f"--checkpoint-dir={tmp_path}",
+                  "--checkpoint-layout=delta", "--keyframe-every=0"])
+
+
+def test_cli_torn_delta_chaos_requires_delta_layout(tmp_path):
+    """--chaos=torn-delta against a layout that never writes delta
+    records is a config the user must not believe they chaos-tested."""
+    for kind in ("torn-delta", "torn-keyframe", "torn-chain"):
+        with pytest.raises(SystemExit, match="checkpoint-layout=delta"):
+            cli.main(["run", "--dimx=8", "--dimy=8",
+                      f"--checkpoint-dir={tmp_path}", f"--chaos={kind}"])
+    # ...and like plain torn, they need a checkpoint dir at all
+    with pytest.raises(SystemExit, match="checkpoint-dir"):
+        cli.main(["run", "--dimx=8", "--dimy=8", "--chaos=torn-delta"])
+
+
+def test_cli_torn_chain_chaos_recovers(tmp_path, capsys):
+    """An armed torn-chain fault against a delta supervised run: the
+    manifest is damaged on disk, the rerun degrades to keyframes and
+    still completes conserved."""
+    d = str(tmp_path / "ck")
+    rc = cli.main(["run", "--flow=diffusion", "--dimx=16", "--dimy=16",
+                   "--steps=4", "--checkpoint-every=2",
+                   f"--checkpoint-dir={d}", "--checkpoint-layout=delta",
+                   "--chaos=torn-chain:4", "--dtype=float64", "--json"])
+    row = json.loads(capsys.readouterr().out)
+    assert rc == 0 and row["injected_faults"] == 1
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the documented degraded mode
+        rc = cli.main(["run", "--flow=diffusion", "--dimx=16",
+                       "--dimy=16", "--steps=8", "--checkpoint-every=2",
+                       f"--checkpoint-dir={d}",
+                       "--checkpoint-layout=delta", "--dtype=float64",
+                       "--json"])
+    row = json.loads(capsys.readouterr().out)
+    assert rc == 0 and row["conserved"] is True

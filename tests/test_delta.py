@@ -1,0 +1,540 @@
+"""Incremental (dirty-tile) checkpointing + live migration (ISSUE 7):
+chain round-trips bitwise against the full layout, replay restore,
+keyframe cadence, chain-integrity-respecting retention, the dirty-tile
+export, and the migration handoffs (serial ↔ sharded executors, across
+ensemble schedulers) — every resume and every handoff BITWISE."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_model_tpu import CellularSpace, Diffusion, Model
+from mpi_model_tpu.io import (
+    CheckpointManager,
+    MigrationError,
+    migrate_scenario,
+    run_checkpointed,
+    transfer_space,
+)
+from mpi_model_tpu.io.checkpoint import CheckpointCorruptionError
+from mpi_model_tpu.io.delta import DeltaChain
+from mpi_model_tpu.models.model import SerialExecutor
+from mpi_model_tpu.ops.active import changed_tile_map, plan_for
+
+RNG = np.random.default_rng(7)
+
+G = 64
+TILE = (8, 8)
+#: one fixed random block — sparse_space must be DETERMINISTIC so a
+#: "same scenario" comparison really compares the same scenario
+SEED_BLOCK = RNG.uniform(0.5, 2.0, (4, 4))
+
+
+def sparse_space(g=G, lo=4, hi=8, roll=0):
+    """Zero ocean with a small fixed random square — the sparse state
+    the delta layout exists for; identical on every call per args."""
+    v = np.zeros((g, g))
+    v[lo:hi, lo:hi] = np.roll(SEED_BLOCK[:hi - lo, :hi - lo], roll, axis=0)
+    return CellularSpace.create(g, g, 0.0, dtype=jnp.float64).with_values(
+        {"value": jnp.asarray(v, jnp.float64)})
+
+
+def make_model(time=10.0):
+    return Model(Diffusion(0.1), time=time, time_step=1.0)
+
+
+def active_ex():
+    return SerialExecutor(step_impl="active", active_opts={"tile": TILE})
+
+
+def delta_mgr(path, keep=100, keyframe_every=4, **kw):
+    return CheckpointManager(str(path), keep=keep, layout="delta",
+                             keyframe_every=keyframe_every,
+                             delta_tile=TILE, **kw)
+
+
+# -- dirty-tile sources -------------------------------------------------------
+
+def test_changed_tile_map_is_exact():
+    plan = plan_for((16, 16), tile=(4, 4))
+    a = RNG.uniform(0.5, 2.0, (16, 16))
+    b = a.copy()
+    b[5, 6] += 1.0   # tile (1, 1)
+    b[12, 0] -= 0.5  # tile (3, 0)
+    m = changed_tile_map(a, b, plan)
+    want = np.zeros((4, 4), bool)
+    want[1, 1] = want[3, 0] = True
+    np.testing.assert_array_equal(m, want)
+    assert not changed_tile_map(a, a, plan).any()
+
+
+def test_changed_tile_map_sees_sign_and_nan_flips():
+    """Byte-level compare: -0.0 vs +0.0 and NaN payloads are changes
+    (value compares would miss the first and destabilize on the
+    second)."""
+    plan = plan_for((8, 8), tile=(4, 4))
+    a = np.zeros((8, 8))
+    b = a.copy()
+    b[0, 0] = -0.0
+    assert changed_tile_map(a, b, plan)[0, 0]
+    c = a.copy()
+    c[7, 7] = np.nan
+    assert changed_tile_map(a, c, plan)[1, 1]
+    assert changed_tile_map(c, c, plan).sum() == 0
+
+
+def test_serial_active_run_exports_dirty_tiles():
+    space, model = sparse_space(), make_model()
+    ex = active_ex()
+    out, _ = model.execute(space, ex, steps=4, check_conservation=False)
+    dt = ex.last_dirty_tiles
+    assert dt is not None and dt["tile"] == TILE
+    # export is a superset of the tiles that actually changed
+    plan = plan_for((G, G), tile=TILE)
+    changed = changed_tile_map(np.asarray(space.values["value"]),
+                               np.asarray(out.values["value"]), plan)
+    assert not np.any(changed & ~np.asarray(dt["map"]))
+    # and it is reset by any run that cannot vouch for one
+    dense = SerialExecutor(step_impl="xla")
+    model.execute(space, dense, steps=1, check_conservation=False)
+    assert dense.last_dirty_tiles is None
+
+
+# -- chain round-trip / replay restore ---------------------------------------
+
+def test_delta_chain_restore_bitwise_equals_full_layout(tmp_path):
+    """The acceptance core: every step restored from the delta chain is
+    bitwise identical to the same step restored from the full layout."""
+    model = make_model()
+    mf = CheckpointManager(str(tmp_path / "full"), keep=100, layout="full")
+    md = delta_mgr(tmp_path / "delta")
+    run_checkpointed(model, sparse_space(), mf, steps=8, every=2,
+                     executor=active_ex())
+    run_checkpointed(model, sparse_space(), md, steps=8, every=2,
+                     executor=active_ex())
+    assert md.steps() == mf.steps()
+    for s in md.steps():
+        a = md.restore(s).space.values["value"]
+        b = mf.restore(s).space.values["value"]
+        np.testing.assert_array_equal(
+            np.asarray(a).view(np.uint8), np.asarray(b).view(np.uint8))
+    # the chain actually holds deltas, and they are smaller than the
+    # keyframe (the whole point)
+    files = sorted(os.listdir(tmp_path / "delta"))
+    kfs = [f for f in files if f.endswith(".kf.npz")]
+    dds = [f for f in files if f.endswith(".d.npz")]
+    assert kfs and dds
+    assert (max(os.path.getsize(tmp_path / "delta" / f) for f in dds)
+            < min(os.path.getsize(tmp_path / "delta" / f) for f in kfs))
+
+
+def test_delta_resume_equivalence(tmp_path):
+    """Interrupted-and-resumed delta-checkpointed run == straight run,
+    bit-identical; the resumed writer CONTINUES the chain with deltas
+    (the restore seeds it) instead of forcing a keyframe."""
+    model = make_model()
+    mgr = delta_mgr(tmp_path, keyframe_every=8)
+    out6, step6, _ = run_checkpointed(model, sparse_space(), mgr, steps=6,
+                                      every=2, executor=active_ex())
+    assert step6 == 6
+    mgr2 = delta_mgr(tmp_path, keyframe_every=8)
+    out10, step10, _ = run_checkpointed(model, sparse_space(), mgr2,
+                                        steps=10, every=2,
+                                        executor=active_ex())
+    assert step10 == 10
+    want, _ = model.execute(sparse_space(), steps=10)
+    np.testing.assert_array_equal(np.asarray(out10.values["value"]),
+                                  np.asarray(want.values["value"]))
+    # the post-resume records at steps 8/10 are deltas, not keyframes
+    names = {f for f in os.listdir(tmp_path)}
+    assert "ckpt_0000000008.d.npz" in names
+
+
+def test_delta_diff_fallback_without_active_executor(tmp_path):
+    """A dense (xla) run exports no dirty tiles: the writer's byte-diff
+    fallback must keep restores bitwise."""
+    model = make_model()
+    mgr = delta_mgr(tmp_path)
+    run_checkpointed(model, sparse_space(), mgr, steps=6, every=2,
+                     executor=SerialExecutor(step_impl="xla"))
+    want, _ = model.execute(sparse_space(), steps=6)
+    ck = mgr.latest()
+    assert ck.step == 6
+    np.testing.assert_array_equal(np.asarray(ck.space.values["value"]),
+                                  np.asarray(want.values["value"]))
+
+
+def test_delta_chain_keyframe_cadence_and_degeneration(tmp_path):
+    """keyframe_every bounds a segment; a delta dirtier than the grid
+    degrades to a keyframe instead of costing more than one."""
+    chain = DeltaChain(str(tmp_path), keyframe_every=3, tile=(8, 8))
+    sp = sparse_space()
+    chain.save(sp, 0)
+    chain.save(sp.with_values(
+        {"value": sp.values["value"].at[4, 4].add(1.0)}), 1)
+    chain.save(sp.with_values(
+        {"value": sp.values["value"].at[5, 5].add(1.0)}), 2)
+    chain.save(sp.with_values(
+        {"value": sp.values["value"].at[6, 6].add(1.0)}), 3)
+    with open(chain.manifest_path) as f:
+        kinds = [r["kind"] for r in json.load(f)["records"]]
+    assert kinds == ["keyframe", "delta", "delta", "keyframe"]
+    # a fully-dirty state degrades the next delta to a keyframe
+    dense = sp.with_values({"value": jnp.asarray(
+        RNG.uniform(0.5, 2.0, (G, G)), jnp.float64)})
+    chain.save(dense, 4)
+    with open(chain.manifest_path) as f:
+        assert json.load(f)["records"][-1]["kind"] == "keyframe"
+
+
+def test_delta_chain_multi_channel_with_int_mask(tmp_path):
+    """A bool/int storage channel beside the flow channel rides the
+    chain bit-exactly (the L0 mixed-dtype seam)."""
+    mask = np.zeros((G, G), bool)
+    mask[10:20, 10:20] = True
+    sp = sparse_space()
+    sp = CellularSpace(
+        {"value": sp.values["value"], "mask": jnp.asarray(mask)}, G, G)
+    model = make_model()
+    mgr = delta_mgr(tmp_path)
+    run_checkpointed(model, sp, mgr, steps=6, every=2,
+                     executor=SerialExecutor(step_impl="xla"),
+                     check_conservation=False)
+    ck = mgr.latest()
+    want, _ = model.execute(sp, steps=6, check_conservation=False)
+    for k in ("value", "mask"):
+        got = np.asarray(ck.space.values[k])
+        assert got.dtype == np.asarray(want.values[k]).dtype
+        np.testing.assert_array_equal(got, np.asarray(want.values[k]))
+
+
+def test_delta_layout_autodetected_by_other_managers(tmp_path):
+    """A full-layout manager resumes from a chain on disk (layout
+    autodetection, the round-4 contract extended to delta)."""
+    mgr = delta_mgr(tmp_path)
+    run_checkpointed(make_model(), sparse_space(), mgr, steps=4, every=2,
+                     executor=active_ex())
+    other = CheckpointManager(str(tmp_path), layout="full")
+    ck = other.latest()
+    assert ck.step == 4
+    want, _ = make_model().execute(sparse_space(), steps=4)
+    np.testing.assert_array_equal(np.asarray(ck.space.values["value"]),
+                                  np.asarray(want.values["value"]))
+
+
+# -- retention: keep-last-N that never breaks a chain -------------------------
+
+def test_prune_mid_chain_keeps_the_supporting_keyframe(tmp_path):
+    """The regression the satellite names: keep=N landing mid-segment
+    must NOT delete the keyframe the retained deltas replay from — the
+    cut moves back to the segment boundary instead."""
+    mgr = delta_mgr(tmp_path, keep=2, keyframe_every=4)
+    model = make_model()
+    run_checkpointed(model, sparse_space(), mgr, steps=10, every=2,
+                     executor=active_ex())
+    # keep=2 would naively retain only [8, 10] — both deltas of the
+    # second segment; the chain must still hold their keyframe
+    steps = mgr.steps()
+    assert steps[-2:] == [8, 10]
+    for s in steps:
+        ck = mgr.restore(s)  # every retained step must replay
+        want, _ = model.execute(sparse_space(), steps=s)
+        np.testing.assert_array_equal(
+            np.asarray(ck.space.values["value"]),
+            np.asarray(want.values["value"]))
+    with open(os.path.join(str(tmp_path), "ckpt_chain.json")) as f:
+        records = json.load(f)["records"]
+    assert records[0]["kind"] == "keyframe"
+    # old segments whose keyframe nothing depends on DID get pruned
+    assert steps[0] >= 4
+
+
+def test_prune_whole_segments_go(tmp_path):
+    """Once a newer keyframe starts a fresh segment, whole old segments
+    are prunable and their files disappear."""
+    mgr = delta_mgr(tmp_path, keep=2, keyframe_every=2)
+    model = make_model()
+    run_checkpointed(model, sparse_space(), mgr, steps=10, every=2,
+                     executor=active_ex())
+    files = os.listdir(tmp_path)
+    with open(os.path.join(str(tmp_path), "ckpt_chain.json")) as f:
+        referenced = {r["file"] for r in json.load(f)["records"]}
+    on_disk = {f for f in files if f.endswith(".npz")}
+    assert on_disk == referenced  # no orphan record files survive
+    assert len(mgr.steps()) <= 4  # keep=2 rounded up to segment bounds
+
+
+# -- chain validation ---------------------------------------------------------
+
+def test_restore_unknown_step_is_filenotfound(tmp_path):
+    mgr = delta_mgr(tmp_path)
+    mgr.save(sparse_space(), 2)
+    with pytest.raises(FileNotFoundError, match="step 7"):
+        mgr.restore(7)
+
+
+def test_missing_delta_record_truncates_chain(tmp_path):
+    """Deleting a mid-chain delta file: the tail restore raises
+    corruption (the manifest promised the record), latest() truncates
+    to the last verified step."""
+    mgr = delta_mgr(tmp_path, keyframe_every=8)
+    model = make_model()
+    run_checkpointed(model, sparse_space(), mgr, steps=8, every=2,
+                     executor=active_ex())
+    os.unlink(os.path.join(str(tmp_path), "ckpt_0000000006.d.npz"))
+    mgr2 = delta_mgr(tmp_path, keyframe_every=8)
+    with pytest.raises(CheckpointCorruptionError, match="missing"):
+        mgr2.restore(8)
+    with pytest.warns(RuntimeWarning, match="failed verification"):
+        ck = mgr2.latest()
+    assert ck.step == 4  # 8 and 6 are unverifiable, 4 replays
+    want, _ = model.execute(sparse_space(), steps=4)
+    np.testing.assert_array_equal(np.asarray(ck.space.values["value"]),
+                                  np.asarray(want.values["value"]))
+
+
+def test_save_after_manifest_loss_adopts_surviving_keyframes(tmp_path):
+    """Review regression: rebuilding the manifest after it is lost must
+    ADOPT the surviving self-contained keyframes — otherwise the next
+    prune's orphan sweep would delete verified history the degraded
+    mode promised to keep."""
+    mgr = delta_mgr(tmp_path, keep=3, keyframe_every=2)
+    model = make_model()
+    run_checkpointed(model, sparse_space(), mgr, steps=8, every=2,
+                     executor=active_ex())  # kf0 d2 kf4 d6 kf8
+    os.unlink(os.path.join(str(tmp_path), "ckpt_chain.json"))
+    mgr2 = delta_mgr(tmp_path, keep=3, keyframe_every=2)
+    ck = mgr2.latest()  # degraded: newest keyframe
+    assert ck.step == 8
+    out, _ = model.execute(ck.space, steps=2)
+    mgr2.save(out, 10)  # rebuilds the manifest (+ prunes to keep=3)
+    steps = mgr2.steps()
+    # older keyframes were adopted, not orphan-swept; retention then
+    # applied its normal keep-N on the rebuilt chain
+    assert 10 in steps and len(steps) >= 3
+    for s in steps:
+        ck = mgr2.restore(s)
+        want, _ = model.execute(sparse_space(), steps=s)
+        np.testing.assert_array_equal(
+            np.asarray(ck.space.values["value"]),
+            np.asarray(want.values["value"]))
+
+
+def test_swapped_record_file_detected_mid_chain(tmp_path):
+    """Review regression: a record file swapped for another of the SAME
+    kind (backup mix-up) passes every per-piece CRC — the per-record
+    identity check (kind/step/base vs the manifest entry) must catch
+    it, including for records that are not the restore target."""
+    import shutil
+
+    mgr = delta_mgr(tmp_path, keyframe_every=8)
+    model = make_model()
+    run_checkpointed(model, sparse_space(), mgr, steps=8, every=2,
+                     executor=active_ex())  # kf0 d2 d4 d6 d8
+    # overwrite the MID-chain delta (step 4) with step 6's record
+    shutil.copyfile(os.path.join(str(tmp_path), "ckpt_0000000006.d.npz"),
+                    os.path.join(str(tmp_path), "ckpt_0000000004.d.npz"))
+    mgr2 = delta_mgr(tmp_path, keyframe_every=8)
+    with pytest.raises(CheckpointCorruptionError, match="drift"):
+        mgr2.restore(8)
+    with pytest.warns(RuntimeWarning, match="failed verification"):
+        ck = mgr2.latest()
+    assert ck.step == 2  # 8/6/4 all replay through the swapped record
+    want, _ = model.execute(sparse_space(), steps=2)
+    np.testing.assert_array_equal(np.asarray(ck.space.values["value"]),
+                                  np.asarray(want.values["value"]))
+
+
+def test_broken_base_link_is_corruption(tmp_path):
+    mgr = delta_mgr(tmp_path)
+    model = make_model()
+    run_checkpointed(model, sparse_space(), mgr, steps=6, every=2,
+                     executor=active_ex())
+    mp = os.path.join(str(tmp_path), "ckpt_chain.json")
+    with open(mp) as f:
+        doc = json.load(f)
+    doc["records"][-1]["base"] = 999  # sever the link
+    with open(mp, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(CheckpointCorruptionError, match="link broken"):
+        delta_mgr(tmp_path).restore(6)
+
+
+# -- migration ----------------------------------------------------------------
+
+def test_migrate_serial_to_sharded_bitwise(eight_devices):
+    from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh
+
+    model = make_model()
+    mesh = make_mesh(4, devices=eight_devices[:4])
+    res = migrate_scenario(model, sparse_space(), source=SerialExecutor(),
+                           target=ShardMapExecutor(mesh), steps=8,
+                           handoff_at=3, transfer_steps=2, tile=TILE)
+    want, _ = model.execute(sparse_space(), steps=8)
+    np.testing.assert_array_equal(np.asarray(res.space.values["value"]),
+                                  np.asarray(want.values["value"]))
+    assert res.handoff_step == 5
+    # the cutover payload is the delta, strictly smaller than the bulk
+    # keyframe for a sparse scenario
+    assert 0 < res.delta_bytes < res.keyframe_bytes
+    assert 0 < res.dirty_tiles < res.ntiles
+
+
+def test_migrate_sharded_to_serial_bitwise(eight_devices):
+    from mpi_model_tpu.parallel import ShardMapExecutor, make_mesh
+
+    model = make_model()
+    mesh = make_mesh(4, devices=eight_devices[:4])
+    res = migrate_scenario(model, sparse_space(),
+                           source=ShardMapExecutor(mesh),
+                           target=SerialExecutor(), steps=8, handoff_at=4,
+                           transfer_steps=1, tile=TILE)
+    want, _ = model.execute(sparse_space(), steps=8)
+    np.testing.assert_array_equal(np.asarray(res.space.values["value"]),
+                                  np.asarray(want.values["value"]))
+
+
+def test_migrate_zero_transfer_steps_is_plain_handoff():
+    model = make_model()
+    res = migrate_scenario(model, sparse_space(), source=SerialExecutor(),
+                           target=SerialExecutor(step_impl="active",
+                                                 active_opts={"tile": TILE}),
+                           steps=6, handoff_at=3, tile=TILE)
+    want, _ = model.execute(sparse_space(), steps=6)
+    np.testing.assert_array_equal(np.asarray(res.space.values["value"]),
+                                  np.asarray(want.values["value"]))
+    assert res.delta_bytes == 0 and res.dirty_tiles == 0
+
+
+def test_migrate_validates_bounds():
+    model = make_model()
+    with pytest.raises(ValueError, match="handoff_at"):
+        migrate_scenario(model, sparse_space(), steps=4, handoff_at=9)
+    with pytest.raises(ValueError, match="transfer_steps"):
+        migrate_scenario(model, sparse_space(), steps=4, handoff_at=2,
+                         transfer_steps=5)
+
+
+def test_transfer_space_roundtrip_and_corruption_detection():
+    sp = sparse_space()
+    t = transfer_space(sp)
+    np.testing.assert_array_equal(
+        np.asarray(sp.values["value"]).view(np.uint8),
+        np.asarray(t.values["value"]).view(np.uint8))
+    # a corrupted wire payload fails its piece CRC loudly
+    from mpi_model_tpu.io import delta as dmod
+
+    values = {k: np.ascontiguousarray(v) for k, v in sp.values.items()}
+    pieces, payload = dmod._full_pieces(values)
+    key = pieces[0]["key"]
+    payload[key] = payload[key].copy()
+    payload[key][100] ^= 0xFF
+    arrays = dmod._new_arrays(dmod._channels_meta(values))
+    with pytest.raises(CheckpointCorruptionError, match="CRC32"):
+        dmod._apply_pieces(arrays,
+                           {"channels": dmod._channels_meta(values),
+                            "pieces": pieces},
+                           lambda k: payload[k], "wire")
+
+
+def test_scheduler_migrate_ticket_bitwise():
+    """Drain a queued scenario onto another scheduler (different bucket
+    ladder + impl): the served result is bitwise what the source
+    scheduler would have produced, counters record the move, and the
+    old ticket is gone."""
+    from mpi_model_tpu.ensemble import EnsembleScheduler
+
+    model = make_model(4.0)
+    spaces = [sparse_space(roll=i) for i in range(3)]
+    src = EnsembleScheduler(max_batch=8)
+    tgt = EnsembleScheduler(max_batch=2, buckets=(1, 2))
+    t0 = src.submit(spaces[0], model, steps=4)
+    t1 = src.submit(spaces[1], model, steps=4)
+    t2 = src.submit(spaces[2], model, steps=4)
+    nt = src.migrate_ticket(t1, tgt)
+    with pytest.raises(KeyError):
+        src.poll(t1)  # forgotten at the source
+    src.pump(force=True)
+    tgt.pump(force=True)
+    moved = tgt.poll(nt)
+    assert moved is not None
+    want, _ = model.execute(spaces[1], SerialExecutor(), steps=4)
+    np.testing.assert_array_equal(np.asarray(moved[0].values["value"]),
+                                  np.asarray(want.values["value"]))
+    for t in (t0, t2):  # batchmates undisturbed
+        assert src.poll(t) is not None
+    assert src.stats()["migrated_out"] == 1
+    assert tgt.stats()["migrated_in"] == 1
+    assert any("migrated_ticket" in d for d in src.dispatch_log)
+
+
+def test_scheduler_migrate_ticket_guards():
+    from mpi_model_tpu.ensemble import EnsembleScheduler
+
+    model = make_model(4.0)
+    sch = EnsembleScheduler(max_batch=4)
+    other = EnsembleScheduler(max_batch=4)
+    t = sch.submit(sparse_space(), model, steps=2)
+    with pytest.raises(ValueError, match="DIFFERENT"):
+        sch.migrate_ticket(t, sch)
+    with pytest.raises(KeyError, match="unknown"):
+        sch.migrate_ticket(999, other)
+    sch.pump(force=True)
+    with pytest.raises(KeyError, match="already served"):
+        sch.migrate_ticket(t, other)
+    assert sch.poll(t) is not None
+
+
+def test_service_migrate_passthrough():
+    from mpi_model_tpu.ensemble import EnsembleService
+
+    model = make_model(4.0)
+    a = EnsembleService(model, steps=4, max_batch=8)
+    b = EnsembleService(model, steps=4, max_batch=2)
+    sp = sparse_space(roll=1)
+    t = a.submit(sp)
+    nt = a.migrate(t, b)
+    out, _ = b.result(nt)
+    want, _ = model.execute(sp, SerialExecutor(), steps=4)
+    np.testing.assert_array_equal(np.asarray(out.values["value"]),
+                                  np.asarray(want.values["value"]))
+
+
+def test_delta_chain_roundtrips_ensemble_scenario_state(tmp_path):
+    """An ensemble-served scenario's state rides the delta chain
+    bitwise: checkpoint mid-run, restore, finish serially — equal to
+    the uninterrupted ensemble lane (the acceptance's ensemble leg)."""
+    from mpi_model_tpu.ensemble import run_ensemble
+
+    model = make_model(8.0)
+    spaces = [sparse_space(roll=i) for i in range(3)]
+    half = run_ensemble(model, spaces, steps=4, check_conservation=False)
+    mgr = delta_mgr(tmp_path)
+    for i, (sp, _rep) in enumerate(half):
+        # one chain per scenario lane (prefix separates them)
+        m = CheckpointManager(str(tmp_path / f"lane{i}"), keep=10,
+                              layout="delta", keyframe_every=4,
+                              delta_tile=TILE)
+        m.save(sp, 4)
+        ck = m.latest()
+        np.testing.assert_array_equal(
+            np.asarray(ck.space.values["value"]).view(np.uint8),
+            np.asarray(sp.values["value"]).view(np.uint8))
+        resumed, _ = model.execute(ck.space, SerialExecutor(), steps=4,
+                                   check_conservation=False)
+        straight = run_ensemble(model, [spaces[i]], steps=8,
+                                check_conservation=False)[0][0]
+        np.testing.assert_array_equal(
+            np.asarray(resumed.values["value"]),
+            np.asarray(straight.values["value"]))
+    assert mgr.steps() == []  # the bare dir itself holds no chain
+
+
+def test_migration_error_type_exists():
+    # the verify failure is hard to trigger without corrupting guts;
+    # assert the contract type is exported and is a RuntimeError so
+    # callers can catch it around a handoff
+    assert issubclass(MigrationError, RuntimeError)
