@@ -75,16 +75,13 @@ def enable_compile_cache() -> None:
     dominate the bench's wall clock; a warm cache turns repeat runs —
     including the driver's — into pure measurement. jax.config.update
     works after jax import, so this also covers callers (the ladder)
-    that initialized jax before importing this module."""
-    import jax
+    that initialized jax before importing this module. The CLI/service
+    expose the same cache behind ``--compile-cache DIR``
+    (``utils.configure_compile_cache`` — one knob-setting site)."""
+    from mpi_model_tpu.utils.compile_cache import configure_compile_cache
 
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                                         "/tmp/mmtpu_jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
-    except (AttributeError, KeyError, ValueError):
-        pass  # older jax without the knobs: cache is an optimization only
+    configure_compile_cache(
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/mmtpu_jax_cache"))
 
 
 def _tols(substeps: int) -> dict:
@@ -534,9 +531,16 @@ def _active_workload(grid: int, frac: float, dtype, rng):
 def bench_active(grid: int = 16384, dtype_name: str = "float32",
                  fracs: tuple = (0.01, 0.05, 0.15), steps_dense: int = 3,
                  steps_active: int = 20, trials: int = 3,
+                 fused_substeps: int = 1,
                  verbose: bool = False) -> dict:
-    """The active-tile engine's speedup-vs-activity-fraction curve at
-    the timed geometry (ISSUE 3 acceptance row).
+    """The active-tile engines' speedup-vs-activity-fraction curves at
+    the timed geometry — the THREE-WAY sweep (ISSUE 3 acceptance row,
+    extended by ISSUE 8): the fused Pallas active kernel
+    (``active_fused``) vs the XLA active engine vs the dense baseline,
+    every pair gated bitwise before timing. On a CPU rig the fused
+    kernel runs in interpret mode, so its ratio columns are an
+    architecture statement only there; the silicon row is a standing
+    pending-silicon item in ROADMAP.md.
 
     For each activity fraction, a point-source wavefront covering that
     share of the domain is stepped through
@@ -585,32 +589,59 @@ def bench_active(grid: int = 16384, dtype_name: str = "float32",
             {"value": _active_workload(g, frac, dt, rng)})
 
     # gate 1: bitwise at f64 on a multi-tile point-source run (needs
-    # jax_enable_x64; reported honestly as skipped otherwise)
-    gate_f64 = None
+    # jax_enable_x64; reported honestly as skipped otherwise) — the
+    # THREE-WAY gate: XLA active vs dense, and the fused Pallas active
+    # kernel (ISSUE 8) vs both
+    gate_f64 = gate_f64_fused = None
     if jax.config.jax_enable_x64:
         sp = make_space(1024, 0.02, jnp.float64)
         oa, _ = model.execute(sp, SerialExecutor(step_impl="active"),
                               steps=12, check_conservation=False)
         ox, _ = model.execute(sp, SerialExecutor(step_impl="xla"),
                               steps=12, check_conservation=False)
+        of, _ = model.execute(sp,
+                              SerialExecutor(step_impl="active_fused"),
+                              steps=12, check_conservation=False)
         gate_f64 = bool(np.array_equal(np.asarray(oa.values["value"]),
                                        np.asarray(ox.values["value"])))
+        gate_f64_fused = bool(
+            np.array_equal(np.asarray(of.values["value"]),
+                           np.asarray(ox.values["value"]))
+            and np.array_equal(np.asarray(of.values["value"]),
+                               np.asarray(oa.values["value"])))
         if not gate_f64:
             raise AssertionError(
                 "active-tile f64 gate failed: active executor output is "
                 "not bitwise equal to the dense XLA path at 1024^2")
+        if not gate_f64_fused:
+            raise AssertionError(
+                "fused active f64 gate failed: active_fused output is "
+                "not bitwise equal to the dense/active paths at 1024^2")
         if verbose:
-            print("  active f64 gate OK (bitwise, 1024^2, 12 steps)",
-                  file=sys.stderr)
+            print("  active f64 gate OK (three-way bitwise, 1024^2, "
+                  "12 steps)", file=sys.stderr)
 
     # gate 2 + rows at the timed geometry
     space = make_space(grid, fracs[0], dtype)
     dense_ex = SerialExecutor(step_impl=dense_impl)
     active_ex = SerialExecutor(step_impl="active")
+    # fused_substeps > 1 composes that many flow steps per tile-resident
+    # kernel pass (composed-k active, ISSUE 8) — k auto-divides it
+    fused_ex = SerialExecutor(step_impl="active_fused",
+                              substeps=int(fused_substeps))
     got_a, _ = model.execute(space, active_ex, steps=1,
                              check_conservation=False)
     got_d, _ = model.execute(space, dense_ex, steps=1,
                              check_conservation=False)
+    got_f, _ = model.execute(space, fused_ex, steps=1,
+                             check_conservation=False)
+    # fused vs XLA active is bitwise at EVERY dtype — both compute in
+    # the storage dtype with the same expression, so no tolerance tier
+    if not np.array_equal(np.asarray(got_f.values["value"]),
+                          np.asarray(got_a.values["value"])):
+        raise AssertionError(
+            f"fused-active timed-geometry gate failed at {grid}^2 "
+            f"{dtype_name}: active_fused step != active step bitwise")
     if dense_ex.last_impl == "xla":
         if not np.array_equal(np.asarray(got_a.values["value"]),
                               np.asarray(got_d.values["value"])):
@@ -650,12 +681,22 @@ def bench_active(grid: int = 16384, dtype_name: str = "float32",
             model.execute(_sp, active_ex, steps=n,
                           check_conservation=False)
 
+        def frun(n, _sp=sp):
+            model.execute(_sp, fused_ex, steps=n,
+                          check_conservation=False)
+
         arun(1)
         as_ = marginal_runner_trials(arun, s1=2, s2=2 + steps_active,
                                      trials=trials)
         amed = statistics.median(as_)
         rep = active_ex.last_backend_report or {}
         asp = positive_spread(as_, grid * grid)
+        frun(1)
+        fs_ = marginal_runner_trials(frun, s1=2, s2=2 + steps_active,
+                                     trials=trials)
+        fmed = statistics.median(fs_)
+        frep = fused_ex.last_backend_report or {}
+        fsp = positive_spread(fs_, grid * grid)
         rows.append({
             "frac": frac,
             "active_step_ms": amed * 1e3 if amed > 0 else None,
@@ -665,12 +706,30 @@ def bench_active(grid: int = 16384, dtype_name: str = "float32",
                                  if amed > 0 and dmed > 0 else None),
             "fallback_steps": rep.get("fallback_steps"),
             "mean_active_fraction": rep.get("mean_active_fraction"),
+            # the fused column of the three-way sweep (interpret-mode
+            # Pallas on a CPU rig — the ratio columns are only an
+            # architecture statement there; the silicon row is the
+            # standing ROADMAP pending item)
+            "fused_step_ms": fmed * 1e3 if fmed > 0 else None,
+            "fused_cups_spread": [fsp["lo"], fsp["hi"]],
+            "fused_eff_cups": grid * grid / fmed if fmed > 0 else None,
+            "fused_speedup_vs_dense": (dmed / fmed
+                                       if fmed > 0 and dmed > 0
+                                       else None),
+            "fused_vs_active": (amed / fmed
+                                if fmed > 0 and amed > 0 else None),
+            "fused_fallback_steps": frep.get("fallback_steps"),
+            "flags_fused": frep.get("flags_fused"),
+            "fused_k": frep.get("composed_k"),
         })
         if verbose:
             r = rows[-1]
             print(f"  frac={frac}: {r['active_step_ms'] or float('nan'):.2f}"
                   f" ms/step, speedup {r['speedup_vs_dense'] or 0:.1f}x "
-                  f"(fallback {r['fallback_steps']})", file=sys.stderr)
+                  f"(fallback {r['fallback_steps']}); fused "
+                  f"{r['fused_step_ms'] or float('nan'):.2f} ms/step "
+                  f"({r['fused_vs_active'] or 0:.2f}x vs active)",
+                  file=sys.stderr)
 
     # gate 3: above-threshold wavefront must fall back AND match
     # (reuses active_ex — same cache key, no redundant trace+compile;
@@ -679,6 +738,14 @@ def bench_active(grid: int = 16384, dtype_name: str = "float32",
     ofb, rfb = model.execute(sp, active_ex, steps=1,
                              check_conservation=False)
     odn, _ = model.execute(sp, dense_ex, steps=1, check_conservation=False)
+    off_, rff = model.execute(sp, fused_ex, steps=1,
+                              check_conservation=False)
+    ffb = (rff.backend_report or {}).get("fallback_steps", 0)
+    if ffb < 1 or not np.array_equal(np.asarray(off_.values["value"]),
+                                     np.asarray(ofb.values["value"])):
+        raise AssertionError(
+            f"fused-active fallback gate failed: fallback_steps={ffb}, "
+            "or the fused fallback diverged from the active fallback")
     fb = (rfb.backend_report or {}).get("fallback_steps", 0)
     fb_match = (bool(np.array_equal(np.asarray(ofb.values["value"]),
                                     np.asarray(odn.values["value"])))
@@ -695,10 +762,13 @@ def bench_active(grid: int = 16384, dtype_name: str = "float32",
 
     best = max((r for r in rows if r["speedup_vs_dense"]),
                key=lambda r: r["speedup_vs_dense"], default=None)
+    bestf = max((r for r in rows if r["fused_speedup_vs_dense"]),
+                key=lambda r: r["fused_speedup_vs_dense"], default=None)
     return {
-        "metric": f"active-tile effective cell-updates/s vs dense "
-                  f"({grid}^2 {dtype_name}, point-source wavefront, "
-                  f"median of {trials})",
+        "metric": f"active-tile effective cell-updates/s, three-way "
+                  f"(fused Pallas active vs XLA active vs dense "
+                  f"baseline; {grid}^2 {dtype_name}, point-source "
+                  f"wavefront, median of {trials})",
         "grid": grid, "dtype": dtype_name,
         "tile": list(plan.tile), "tiles": plan.ntiles,
         "capacity": plan.capacity,
@@ -708,10 +778,14 @@ def bench_active(grid: int = 16384, dtype_name: str = "float32",
         "dense_cups_spread": [dsp["lo"], dsp["hi"]],
         "trials": trials,
         "gate_bitwise_f64": gate_f64,
+        "gate_bitwise_f64_fused": gate_f64_fused,
         "fallback_gate": {"engaged_steps": int(fb),
-                          "matches_dense": bool(fb_match)},
+                          "matches_dense": bool(fb_match),
+                          "fused_engaged_steps": int(ffb)},
         "rows": rows,
         "best_speedup": best["speedup_vs_dense"] if best else None,
+        "best_fused_speedup": (bestf["fused_speedup_vs_dense"]
+                               if bestf else None),
     }
 
 

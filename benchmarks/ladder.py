@@ -781,8 +781,32 @@ def config7(quick: bool = False) -> dict:
             **row}
 
 
+def config8(quick: bool = False) -> dict:
+    """Fused Pallas active kernel (ISSUE 8): the three-way activity
+    sweep — ``active_fused`` (scalar-prefetched sparse streaming,
+    in-kernel flags, composed-k passes) vs the XLA active engine vs the
+    dense baseline, at the timed 16384² geometry with composed k=8
+    passes. Every pair is gated bitwise before timing (f64 three-way +
+    timed-geometry fused-vs-active). On a CPU rig the fused kernel runs
+    in interpret mode — those ratio columns are an architecture
+    statement; the silicon row is the standing ROADMAP pending item."""
+    import bench as bench_mod
+
+    g = 256 if quick else 16384
+    row = bench_mod.bench_active(
+        grid=g, fracs=(0.05,) if quick else (0.01, 0.05, 0.15),
+        steps_dense=2 if quick else 3,
+        steps_active=5 if quick else 20,
+        trials=1 if quick else 3,
+        fused_substeps=2 if quick else 8)
+    return {"config": 8, "flow": "diffusion (point-source wavefront)",
+            "strategy": "fused Pallas active (composed-k) vs XLA active "
+                        "vs dense",
+            **row}
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7}
+           6: config6, 7: config7, 8: config8}
 
 
 def sweep_blocks(grid: int = 8192, dtype_name: str = "bfloat16") -> list:

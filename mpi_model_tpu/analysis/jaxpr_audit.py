@@ -27,12 +27,21 @@ audited contracts:
     composed sub-steps for the composed filter, with ``k·passes ==
     substeps``).
 
+``jaxpr-fused-flags``
+    the fused active runner's per-pass while body carries no reduction
+    at tile size or larger outside the kernel — the next-step activity
+    flags come out of the Pallas pass itself (ISSUE 8's structural
+    win), never a separate per-step re-scan.
+
 Audited impls: ``dense`` (the XLA stencil step), ``composed`` (k-step
 filter), ``active`` (tile-skipping engine), ``ensemble`` (the vmapped
-parametric scenario step). The Pallas kernel impl is exercised by its
-own runtime suite; its jaxpr is backend-shaped and is audited where it
-matters — through the composed contract, which traces the same
-``_stencil_call`` machinery in interpret mode.
+parametric scenario step), ``active_fused`` (the stateless fused
+Pallas active step — scalar-prefetch-argument and halo k·passes ==
+substeps contracts) and ``active_fused_runner`` (the amortized fused
+loop — the jaxpr-fused-flags contract). The dense Pallas kernel impl
+is exercised by its own runtime suite; its jaxpr is backend-shaped and
+is audited where it matters — through the composed contract, which
+traces the same ``_stencil_call`` machinery in interpret mode.
 """
 
 from __future__ import annotations
@@ -72,6 +81,11 @@ _register("jaxpr-consts",
 _register("jaxpr-halo",
           "stencil radius must fit the halo depth the impl's sharded "
           "configuration declares")
+_register("jaxpr-fused-flags",
+          "the fused active runner's per-pass loop must carry no "
+          "reduction at tile size or larger outside the kernel — "
+          "activity flags come out of the Pallas pass, never a "
+          "separate per-step reduction")
 
 
 @dataclasses.dataclass
@@ -88,6 +102,15 @@ class BuiltStep:
     composed_k: Optional[int] = None
     composed_passes: Optional[int] = None
     substeps: int = 1
+    #: False for runner-shaped contracts whose outputs legitimately
+    #: carry stat counters beside the space-dtype values
+    dtype_check: bool = True
+    #: the fused impls: every pallas_call must scalar-prefetch its
+    #: index buffer as a traced ARGUMENT (never a baked literal)
+    expect_prefetch_arg: bool = False
+    #: when set (tile cell count), enforce jaxpr-fused-flags on every
+    #: innermost while body that contains a pallas_call
+    fused_flags_tile_elems: Optional[int] = None
 
 
 #: impl name → zero-arg builder (registered below)
@@ -177,6 +200,54 @@ def _build_ensemble() -> BuiltStep:
         space.dtype, v0.dtype.itemsize * v0.size, model.offsets, 1)
 
 
+@contract("active_fused")
+def _build_active_fused() -> BuiltStep:
+    # the stateless fused step: substeps=4 on a 64² f64 grid composes
+    # one k=4 pass per call (tile (64, 64) admits k up to MAX_FUSED_K);
+    # the runner-shaped loop contract is audited separately below
+    space, model = _space_model("float64", 64, with_point=False)
+    with warnings.catch_warnings():
+        # CPU rig: the dense-fallback Pallas probe warns and degrades
+        # to the XLA transport — expected, and the path we audit
+        warnings.simplefilter("ignore")
+        step = model.make_step(space, impl="active_fused", substeps=4)
+    args = {k: _sds(v) for k, v in space.values.items()}
+    v0 = next(iter(space.values.values()))
+    return BuiltStep("active_fused", step, (args,), space.dtype,
+                     v0.dtype.itemsize * v0.size, model.offsets,
+                     halo_depth=step.composed_k,
+                     composed_k=step.composed_k,
+                     composed_passes=step.composed_passes, substeps=4,
+                     expect_prefetch_arg=True)
+
+
+@contract("active_fused_runner")
+def _build_active_fused_runner() -> BuiltStep:
+    # the amortized whole-run form (SerialExecutor's fast path): the
+    # jaxpr-fused-flags contract lives HERE — its per-pass while body
+    # must carry no tile-or-larger reduction outside the kernel
+    import jax
+    import numpy as np
+    from ..ops.active import plan_for
+    from ..ops.pallas_active import build_fused_runner, choose_fused_k
+    space, model = _space_model("float64", 64, with_point=False)
+    plan = plan_for(space.shape)
+    k = choose_fused_k(4, plan)
+    rates = model.pallas_rates()
+    run = build_fused_runner(space.shape, rates, model.offsets,
+                             space.dtype, plan=plan, k=k,
+                             track_dirty=True)
+    args = ({kk: _sds(v) for kk, v in space.values.items()},
+            jax.ShapeDtypeStruct((), np.dtype("int32")))
+    v0 = next(iter(space.values.values()))
+    return BuiltStep("active_fused_runner", run, args, space.dtype,
+                     v0.dtype.itemsize * v0.size, model.offsets,
+                     halo_depth=k, composed_k=k, composed_passes=1,
+                     substeps=k, dtype_check=False,
+                     expect_prefetch_arg=True,
+                     fused_flags_tile_elems=plan.tile[0] * plan.tile[1])
+
+
 # -- jaxpr walks --------------------------------------------------------------
 
 def _iter_eqns(jaxpr):
@@ -199,6 +270,52 @@ def _as_jaxprs(val, Jaxpr):
     elif isinstance(val, (list, tuple)):
         for v in val:
             yield from _as_jaxprs(v, Jaxpr)
+
+
+#: reduction primitives the jaxpr-fused-flags contract scans for —
+#: genuine cross-element reductions only (``reduce_precision`` is an
+#: elementwise cast and must NOT match, hence no substring matching)
+REDUCE_PRIMS = frozenset({
+    "reduce_sum", "reduce_prod", "reduce_max", "reduce_min",
+    "reduce_or", "reduce_and", "reduce_xor", "argmax", "argmin",
+    "reduce_window_sum", "reduce_window_max", "reduce_window_min",
+})
+
+
+def _iter_eqns_outside_pallas(jaxpr):
+    """Like ``_iter_eqns`` but does NOT descend into a pallas_call's
+    kernel jaxpr — the fused-flags contract is about what runs OUTSIDE
+    the kernel (in-kernel reductions over the VMEM-resident tile are
+    the whole point)."""
+    from ..compat import jaxpr_type
+    Jaxpr = jaxpr_type()
+    for eqn in jaxpr.eqns:
+        yield eqn
+        if "pallas" in eqn.primitive.name:
+            continue
+        for val in eqn.params.values():
+            for sub in _as_jaxprs(val, Jaxpr):
+                yield from _iter_eqns_outside_pallas(sub)
+
+
+def _has_eqn(jaxpr, pred) -> bool:
+    return any(pred(eqn) for eqn in _iter_eqns_outside_pallas(jaxpr))
+
+
+def _grid_reductions(jaxpr, min_elems: int):
+    """Reduction eqns (outside kernels) whose any input reaches
+    ``min_elems`` elements — the per-pass loop of the fused runner must
+    have none (flags come out of the kernel)."""
+    import math
+    for eqn in _iter_eqns_outside_pallas(jaxpr):
+        if eqn.primitive.name not in REDUCE_PRIMS:
+            continue
+        for v in eqn.invars:
+            aval = getattr(v, "aval", None)
+            size = int(math.prod(getattr(aval, "shape", ())))
+            if size >= min_elems:
+                yield eqn, size
+                break
 
 
 def stencil_radius(offsets) -> int:
@@ -232,16 +349,19 @@ def audit_built(built: BuiltStep) -> list[Finding]:
         return findings
 
     # dtype stability: every output aval carries the space dtype
+    # (runner-shaped contracts opt out — their stat counters are
+    # integer outputs by design)
     import numpy as np
     want = np.dtype(built.space_dtype)
-    for i, aval in enumerate(closed.out_avals):
-        got = np.dtype(aval.dtype)
-        if got != want:
-            findings.append(Finding(
-                "jaxpr-dtype", Severity.ERROR, where, 0,
-                f"output {i} of the {built.impl} step has dtype "
-                f"{got.name}, space dtype is {want.name} — a silent "
-                "promotion/downcast crossed the step boundary"))
+    if built.dtype_check:
+        for i, aval in enumerate(closed.out_avals):
+            got = np.dtype(aval.dtype)
+            if got != want:
+                findings.append(Finding(
+                    "jaxpr-dtype", Severity.ERROR, where, 0,
+                    f"output {i} of the {built.impl} step has dtype "
+                    f"{got.name}, space dtype is {want.name} — a silent "
+                    "promotion/downcast crossed the step boundary"))
 
     # hot-path purity: no host-callback/debug primitives anywhere
     for eqn in _iter_eqns(closed.jaxpr):
@@ -290,6 +410,63 @@ def audit_built(built: BuiltStep) -> list[Finding]:
                 f"composed k={k} × passes={passes} != substeps="
                 f"{built.substeps} — the composed call no longer equals "
                 "the iterated step count"))
+
+    # fused-impl contracts (ISSUE 8): the kernel actually lowered, and
+    # its scalar-prefetched operands — the compacted index buffer above
+    # all — are traced ARGUMENTS, never baked literals (a literal ids
+    # buffer would freeze one activity pattern into the compile)
+    if built.expect_prefetch_arg:
+        from ..compat import literal_type
+        Literal = literal_type()
+        n_pallas = 0
+        for eqn in _iter_eqns(closed.jaxpr):
+            if "pallas" not in eqn.primitive.name:
+                continue
+            n_pallas += 1
+            gm = eqn.params.get("grid_mapping")
+            nsp = int(getattr(gm, "num_index_operands", 0) or 0)
+            if nsp < 1:
+                findings.append(Finding(
+                    "jaxpr-consts", Severity.ERROR, where, 0,
+                    f"a pallas_call in the {built.impl} step prefetches "
+                    "no scalar operands — the fused contract requires "
+                    "the compacted index buffer to ride scalar prefetch"))
+                continue
+            for v in eqn.invars[:nsp]:
+                if isinstance(v, Literal):
+                    findings.append(Finding(
+                        "jaxpr-consts", Severity.ERROR, where, 0,
+                        f"a scalar-prefetch operand of a pallas_call in "
+                        f"the {built.impl} step is a baked literal — the "
+                        "index buffer must be a traced argument"))
+        if n_pallas == 0:
+            findings.append(Finding(
+                "jaxpr-consts", Severity.ERROR, where, 0,
+                f"the {built.impl} step lowered no pallas_call at all — "
+                "the fused kernel is not in the hot path"))
+
+    # jaxpr-fused-flags: every innermost while body that runs the
+    # kernel must be free of tile-or-larger reductions outside it —
+    # the per-pass activity flags come out of the Pallas pass, never a
+    # separate per-step reduction (the O(grid)/O(capacity-buffer)
+    # re-scan the fused engine exists to eliminate)
+    if built.fused_flags_tile_elems is not None:
+        thresh = int(built.fused_flags_tile_elems)
+        for eqn in _iter_eqns(closed.jaxpr):
+            if eqn.primitive.name != "while":
+                continue
+            body = eqn.params["body_jaxpr"].jaxpr
+            if not _has_eqn(body, lambda e: "pallas" in e.primitive.name):
+                continue
+            if _has_eqn(body, lambda e: e.primitive.name == "while"):
+                continue  # outer nest: the dense-fallback branch may scan
+            for bad, size in _grid_reductions(body, thresh):
+                findings.append(Finding(
+                    "jaxpr-fused-flags", Severity.ERROR, where, 0,
+                    f"`{bad.primitive.name}` over {size} elements inside "
+                    f"the {built.impl} per-pass loop — activity flags "
+                    "must come out of the fused kernel, not a separate "
+                    "per-step reduction"))
     return findings
 
 

@@ -233,7 +233,8 @@ def _run_ensemble(args, space, model) -> int:
     svc = EnsembleService(
         model, steps=steps, impl=args.ensemble_impl,
         substeps=args.substeps, buckets=buckets_for(B),
-        compute_dtype=_compute_dtype(args), check_conservation=False)
+        compute_dtype=_compute_dtype(args), check_conservation=False,
+        compile_cache=args.compile_cache)
     t0 = _time.perf_counter()
     try:
         tickets = [svc.submit(space) for _ in range(B)]
@@ -294,7 +295,12 @@ def _run_ensemble(args, space, model) -> int:
 def cmd_run(args) -> int:
     import time as _time
 
+    from .utils.compile_cache import configure_compile_cache
     from .utils.tracing import get_tracer
+
+    # arm the persistent compilation cache BEFORE anything compiles —
+    # idempotent, and None (flag unset) leaves jax untouched
+    configure_compile_cache(args.compile_cache)
 
     # inapplicable flag combinations are errors, not silent no-ops — a
     # user must not believe they benchmarked a configuration that never
@@ -362,7 +368,7 @@ def cmd_run(args) -> int:
         if args.impl != "auto":
             raise SystemExit(
                 "--impl selects the single-run kernel; ensemble runs "
-                "use --ensemble-impl=xla|pipeline|active")
+                "use --ensemble-impl=xla|pipeline|active|active_fused")
     elif args.ensemble_impl != "xla":
         raise SystemExit("--ensemble-impl applies to ensemble runs; "
                          "add --ensemble=B")
@@ -598,7 +604,7 @@ def main(argv: Optional[list[str]] = None) -> int:
                      choices=["float32", "float64", "bfloat16"])
     run.add_argument("--impl", default="auto",
                      choices=["xla", "pallas", "auto", "composed",
-                              "active"],
+                              "active", "active_fused"],
                      help="field-flow kernel: 'composed' runs the "
                      "k-step composed tap filter (uniform-rate "
                      "Diffusion only; pair with --substeps=k serially "
@@ -606,7 +612,11 @@ def main(argv: Optional[list[str]] = None) -> int:
                      "active-tile engine (compute only tiles whose "
                      "ring-1 neighborhood holds mass — bitwise-exact "
                      "skipping for uniform-rate Diffusion, dense "
-                     "fallback above the activity threshold)")
+                     "fallback above the activity threshold); "
+                     "'active_fused' runs the fused Pallas active "
+                     "kernel (scalar-prefetched sparse streaming with "
+                     "in-kernel activity flags; --substeps=k composes "
+                     "k flow steps per tile-resident pass)")
     run.add_argument("--compute-dtype", default=None,
                      choices=["float32", "bfloat16"],
                      help="Pallas interior-tile math dtype (default f32; "
@@ -614,6 +624,13 @@ def main(argv: Optional[list[str]] = None) -> int:
                      "throughput; the near-ring exact path stays f32)")
     run.add_argument("--substeps", type=int, default=1,
                      help="fused steps per compiled call (serial executor)")
+    run.add_argument("--compile-cache", default=None, metavar="DIR",
+                     help="arm the JAX persistent compilation cache at "
+                     "DIR (created if missing): every kernel/runner "
+                     "compile on this machine is paid once and reused "
+                     "across processes — a restarted run or service "
+                     "skips straight to execution (ROADMAP direction 5, "
+                     "first slice)")
     run.add_argument("--ensemble", type=int, default=None, metavar="B",
                      help="step B independent copies of the scenario as "
                      "ONE batched device program through the ensemble "
@@ -621,14 +638,17 @@ def main(argv: Optional[list[str]] = None) -> int:
                      "conservation); reports scenarios/s, batch "
                      "occupancy and compile-cache hits")
     run.add_argument("--ensemble-impl", default="xla",
-                     choices=["xla", "pipeline", "active"],
+                     choices=["xla", "pipeline", "active",
+                              "active_fused"],
                      help="ensemble interior engine: 'xla' (vmapped "
                      "parametric step — any flows, per-scenario rates), "
                      "'pipeline' (the pipelined-window Pallas kernel "
                      "per lane — all-Diffusion, one shared rate, grid "
-                     "divisible into 16x128 strips), or 'active' (the "
+                     "divisible into 16x128 strips), 'active' (the "
                      "active-tile engine per lane — all-Diffusion, "
-                     "per-scenario rates and per-scenario activity)")
+                     "per-scenario rates and per-scenario activity), "
+                     "or 'active_fused' (the fused Pallas active "
+                     "kernel per lane)")
     run.add_argument("--mesh", default=None,
                      help="LxC device mesh for sharded execution "
                      "(e.g. 4x1, 2x4); omit for serial")

@@ -57,6 +57,38 @@ def tpu_compiler_params(*, vmem_limit_bytes: Optional[int] = None):
     return _COMPILER_PARAMS(vmem_limit_bytes=vmem_limit_bytes)
 
 
+def literal_type():
+    """The ``Literal`` class (jaxpr invars that are inline constants)
+    under whichever module this jax exports it — the fused-kernel
+    auditor uses it to prove the scalar-prefetched index buffer reaches
+    ``pallas_call`` as a traced argument, never a baked literal."""
+    try:
+        from jax.extend.core import Literal
+    except ImportError:
+        from jax.core import Literal
+    return Literal
+
+
+def prefetch_scalar_grid_spec(*, num_scalar_prefetch, grid, in_specs,
+                              out_specs, scratch_shapes):
+    """``pltpu.PrefetchScalarGridSpec`` — the TPU grid spec whose
+    leading operands are scalar-prefetched (available to index maps and
+    to the kernel before the body runs; the sparse-streaming shape the
+    fused active kernel is built on). Stable across the 0.4.x → current
+    window under this one name; bridged here so a future rename has one
+    place to land, and so a jax WITHOUT it fails with a clear message
+    at build time instead of an AttributeError mid-trace."""
+    spec_cls = getattr(pltpu, "PrefetchScalarGridSpec", None)
+    if spec_cls is None:  # pragma: no cover - jax without scalar prefetch
+        raise NotImplementedError(
+            "this jax exposes no pltpu.PrefetchScalarGridSpec; the fused "
+            "active kernel (impl='active_fused') needs it — use "
+            "impl='active' (the XLA engine) on this rig")
+    return spec_cls(num_scalar_prefetch=num_scalar_prefetch, grid=grid,
+                    in_specs=in_specs, out_specs=out_specs,
+                    scratch_shapes=scratch_shapes)
+
+
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma: Optional[bool] = None):
     """``jax.shard_map`` when available, else the experimental spelling
     with ``check_vma`` translated to its old name ``check_rep``."""

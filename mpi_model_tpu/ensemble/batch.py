@@ -379,6 +379,12 @@ class EnsembleExecutor:
     - ``"active"``: the active-tile engine per lane (``ops.active``,
       ISSUE 3) — each scenario skips its own quiet ocean; all-Diffusion
       batches with per-lane rates (any float dtype, f64 included).
+    - ``"active_fused"``: the fused Pallas active kernel per lane
+      (``ops.pallas_active``, ISSUE 8) — the active engine's skip rule
+      with scalar-prefetched window streaming and in-kernel flag
+      computation; same eligibility as ``"active"``. Per-lane rates are
+      traced, so every pass runs the exact iterated path (tap tables
+      need a concrete rate).
 
     ``substeps`` fuses that many model steps per compiled step call
     (kernel-fused on the pipeline path; composed singles on the XLA
@@ -393,10 +399,10 @@ class EnsembleExecutor:
 
     def __init__(self, impl: str = "xla", substeps: int = 1,
                  compute_dtype=None):
-        if impl not in ("xla", "pipeline", "active"):
+        if impl not in ("xla", "pipeline", "active", "active_fused"):
             raise ValueError(
                 f"unknown ensemble impl {impl!r} (expected 'xla', "
-                "'pipeline' or 'active')")
+                "'pipeline', 'active' or 'active_fused')")
         self.impl = impl
         self.substeps = max(1, int(substeps))
         #: interior-tile math dtype for the pipeline kernel (None → f32)
@@ -425,8 +431,9 @@ class EnsembleExecutor:
         self.builds += 1
         if self.impl == "pipeline":
             runner = self._build_pipeline(model, espace, uniform_rates)
-        elif self.impl == "active":
-            runner = self._build_active(model, espace)
+        elif self.impl in ("active", "active_fused"):
+            runner = self._build_active(model, espace,
+                                        fused=self.impl == "active_fused")
         else:
             runner = self._build_xla(model, espace)
         self._cache[key] = runner
@@ -481,7 +488,8 @@ class EnsembleExecutor:
             self._cache[key] = fn
         return fn
 
-    def _build_active(self, model, espace: EnsembleSpace):
+    def _build_active(self, model, espace: EnsembleSpace,
+                      fused: bool = False):
         """Per-scenario ACTIVITY (ISSUE 3): each lane runs the
         active-tile whole-run stepper (``ops.active`` — pad once, carry
         the tile map, compute only active tiles, dense-fallback above
@@ -497,11 +505,12 @@ class EnsembleExecutor:
         multiply, ~1 ULP from the serial summed-outflow grouping)."""
         from ..ops import active as act
 
+        impl_name = "active_fused" if fused else "active"
         flows = list(model.flows)
         if not flows or any(type(f) is not Diffusion for f in flows):
             raise ValueError(
-                "impl='active' supports all-Diffusion scenario batches "
-                "(the tile-skip rule is only bitwise-exact for "
+                f"impl={impl_name!r} supports all-Diffusion scenario "
+                "batches (the tile-skip rule is only bitwise-exact for "
                 "uniform-rate linear flows); got "
                 f"flows={[type(f).__name__ for f in flows]}. "
                 "Use impl='xla'.")
@@ -513,15 +522,28 @@ class EnsembleExecutor:
                     f"{adt} for channel {f.attr!r}")
             if adt != jnp.dtype(espace.dtype):
                 raise ValueError(
-                    "impl='active' computes every flow channel in the "
-                    f"space dtype ({jnp.dtype(espace.dtype).name}); "
+                    f"impl={impl_name!r} computes every flow channel in "
+                    f"the space dtype ({jnp.dtype(espace.dtype).name}); "
                     f"channel {f.attr!r} is {adt}. Use impl='xla'.")
         attr_idx: dict[str, list[int]] = {}
         for i, f in enumerate(flows):
             attr_idx.setdefault(f.attr, []).append(i)
-        lane = act.build_active_runner(
-            espace.shape, attr_idx, model.offsets, espace.dtype,
-            traced_rates=True)
+        if fused:
+            from ..ops.pallas_active import (build_fused_runner,
+                                             choose_fused_k)
+            from ..ops.pallas_stencil import resolve_interpret
+
+            plan = act.plan_for(espace.shape)
+            lane = build_fused_runner(
+                espace.shape, attr_idx, model.offsets, espace.dtype,
+                plan=plan, k=choose_fused_k(self.substeps, plan),
+                traced_rates=True,
+                interpret=resolve_interpret(
+                    next(iter(espace.values.values()))))
+        else:
+            lane = act.build_active_runner(
+                espace.shape, attr_idx, model.offsets, espace.dtype,
+                traced_rates=True)
         substeps = self.substeps
 
         def run(vb, rates_b, frozens_b, q, r):
@@ -679,11 +701,18 @@ def run_ensemble(model, spaces, *, models=None, executor=None, steps=None,
     # [B] active-tile) stat lanes alongside the values; fold them into
     # backend_report so a batch that dense-fell-back every step is
     # visible, not silently labeled "active" (serial/sharded contract)
-    fb_arr = at_arr = None
+    fb_arr = at_arr = ff_arr = None
     if executor.impl == "active":
         out, (fb_b, at_b) = out
         fb_arr = np.asarray(fb_b)
         at_arr = np.asarray(at_b)
+    elif executor.impl == "active_fused":
+        # the fused lanes also carry the [B] flags_fused counter —
+        # passes whose next-step flags came out of the kernel
+        out, (fb_b, at_b, ff_b) = out
+        fb_arr = np.asarray(fb_b)
+        at_arr = np.asarray(at_b)
+        ff_arr = np.asarray(ff_b)
     # chaos seam (resilience.inject): an armed lane_nan fault writes
     # NaN into a scenario lane's OUTPUT here — upstream of the totals,
     # so the per-lane conservation machinery must catch it exactly the
@@ -700,9 +729,15 @@ def run_ensemble(model, spaces, *, models=None, executor=None, steps=None,
 
         plan = plan_for(espace.shape)
         nattr = len({f.attr for f in model.flows})
-        denom = num_steps * nattr * plan.ntiles
+        if ff_arr is not None:
+            from ..ops.pallas_active import choose_fused_k, pass_count
+            fused_k = choose_fused_k(executor.substeps, plan)
+            passes = pass_count(num_steps, fused_k)
+        else:
+            fused_k, passes = None, num_steps
+        denom = passes * nattr * plan.ntiles
         executor.last_backend_report = {
-            "impl": "active",
+            "impl": executor.impl,
             "steps": num_steps,
             "lanes": count,
             #: (attr, step) dense-fallback events summed over REAL lanes
@@ -717,6 +752,13 @@ def run_ensemble(model, spaces, *, models=None, executor=None, steps=None,
                 float(at_arr[:count].sum()) / (count * denom)
                 if count and denom else None),
         }
+        if ff_arr is not None:
+            executor.last_backend_report.update({
+                "composed_k": fused_k,
+                "passes": passes,
+                "flags_fused": int(ff_arr[:count].sum()),
+                "per_lane_flags_fused": [int(x) for x in ff_arr[:count]],
+            })
 
     last_exec = np.asarray(
         executor.last_execute_for(model, espace)(out, rates_b, frozens_b),
@@ -756,10 +798,12 @@ def run_ensemble(model, spaces, *, models=None, executor=None, steps=None,
             last_execute=[float(x) for x in last_exec[i]],
             wall_time_s=wall,
             backend_report=(None if fb_arr is None else {
-                "impl": "active",
+                "impl": executor.impl,
                 "fallback_steps": int(fb_arr[i]),
                 "mean_active_fraction": (
                     float(at_arr[i]) / denom if denom else None),
+                **({} if ff_arr is None
+                   else {"flags_fused": int(ff_arr[i])}),
             }),
         )))
     return results

@@ -31,9 +31,10 @@ fault and the scenario's result is recovered; a solo failure means the
 scenario itself is poisoned and it is QUARANTINED with a
 ``FailureEvent`` (batchmates are never retried — their results, good or
 bad, stand). Repeated impl-level faults engage the degradation ladder:
-``pipeline`` → ``xla`` and ``active`` → ``xla`` (the dense vmapped
-step), reported through ``stats()``/``backend_report`` rather than
-silently. ``dispatch_deadline_s`` bounds a dispatch by the injectable
+``active_fused`` → ``active`` → ``xla`` and ``pipeline`` → ``xla``
+(each rung after ``degrade_after`` fresh faults; the fused kernel
+first sheds only its Pallas layer, keeping the activity win), reported
+through ``stats()``/``backend_report`` rather than silently. ``dispatch_deadline_s`` bounds a dispatch by the injectable
 clock: an overrun (a hung dispatch) is a ``DispatchTimeout`` handled
 through the same retry/quarantine machinery.
 """
@@ -126,8 +127,8 @@ class EnsembleScheduler:
         self.retry = retry
         #: deadline per dispatch by the injectable clock (None = off)
         self.dispatch_deadline_s = dispatch_deadline_s
-        #: impl-level faults tolerated before the degradation ladder
-        #: swaps pipeline/active for the always-eligible "xla" engine
+        #: impl-level faults tolerated per ladder rung (DEGRADE_TO):
+        #: active_fused → active → xla, pipeline → xla
         self.degrade_after = int(degrade_after)
         #: the impl the ladder degraded AWAY from (None = never engaged)
         self.degraded_from: Optional[str] = None
@@ -510,25 +511,38 @@ class EnsembleScheduler:
         self._results[it.ticket] = err
         self._pending_tickets.discard(it.ticket)
 
+    #: the degradation ladder: each impl's next-simpler engine. The
+    #: fused active kernel steps DOWN to the XLA active engine first
+    #: (same skip rule, no Pallas in the path — a kernel-level fault
+    #: should not cost the activity win), and only then to the dense
+    #: vmapped step; pipeline/active go straight to "xla".
+    DEGRADE_TO = {"active_fused": "active", "active": "xla",
+                  "pipeline": "xla"}
+
     def _note_impl_fault(self, err: Exception) -> None:
         """Count an impl/dispatch-level fault toward the degradation
-        ladder; at ``degrade_after`` the executor degrades to the
-        always-eligible dense engine (``pipeline`` → ``xla``,
-        ``active`` → ``xla``) — announced, counted, and stamped onto
-        every subsequently served report."""
+        ladder; every ``degrade_after`` faults the executor degrades one
+        rung (``active_fused`` → ``active`` → ``xla``, ``pipeline`` →
+        ``xla``) — announced, counted, and stamped onto every
+        subsequently served report. ``degraded_from`` keeps the impl the
+        ladder FIRST degraded away from (what the operator configured);
+        the current engine is ``stats()["impl"]``."""
         self.counter.impl_faults += 1
         self._impl_fault_count += 1
-        if (self.degraded_from is None
-                and self._impl_fault_count >= self.degrade_after
-                and self.executor.impl in ("pipeline", "active")):
+        nxt = self.DEGRADE_TO.get(self.executor.impl)
+        if (nxt is not None
+                and self._impl_fault_count >= self.degrade_after):
             old = self.executor.impl
-            self.degraded_from = old
+            if self.degraded_from is None:
+                self.degraded_from = old
+            # each further rung needs degrade_after NEW faults
+            self._impl_fault_count = 0
             self.executor = EnsembleExecutor(
-                impl="xla", substeps=self.executor.substeps,
+                impl=nxt, substeps=self.executor.substeps,
                 compute_dtype=self.executor.compute_dtype)
             warnings.warn(
-                f"ensemble impl {old!r} degraded to 'xla' after "
-                f"{self._impl_fault_count} impl-level dispatch fault(s) "
+                f"ensemble impl {old!r} degraded to {nxt!r} after "
+                f"{self.degrade_after} impl-level dispatch fault(s) "
                 f"(last: {type(err).__name__}: {err})", RuntimeWarning)
 
     # -- observability -------------------------------------------------------

@@ -30,6 +30,13 @@ class EnsembleService:
     self-healing knobs: ``retry="solo"`` for retry-with-quarantine,
     ``dispatch_deadline_s`` for the hung-dispatch bound,
     ``degrade_after`` for the impl degradation ladder).
+
+    ``compile_cache`` (a directory path) points the JAX persistent
+    compilation cache there before the first dispatch compiles
+    (``utils.configure_compile_cache``): a restarted service re-uses
+    every executable a previous process on this machine already built —
+    the per-machine cold-start eliminator of ROADMAP direction 5,
+    surfaced as the CLI's ``--compile-cache`` flag.
     """
 
     def __init__(self, model, *, steps: Optional[int] = None,
@@ -41,7 +48,13 @@ class EnsembleService:
                  clock: Callable[[], float] = time.monotonic,
                  retry: str = "none",
                  dispatch_deadline_s: Optional[float] = None,
-                 degrade_after: int = 2):
+                 degrade_after: int = 2,
+                 compile_cache: Optional[str] = None):
+        from ..utils.compile_cache import configure_compile_cache
+
+        #: the persistent-cache dir actually armed (None = disabled or
+        #: unsupported by this jax — the service still serves)
+        self.compile_cache = configure_compile_cache(compile_cache)
         self.model = model
         self.default_steps = (model.num_steps if steps is None
                               else int(steps))

@@ -110,7 +110,12 @@ class SerialExecutor:
     models run the amortized whole-run active stepper: pad once, carry
     the tile map across steps, compute only active tiles; per-step dense
     fallbacks and the measured activity land in
-    ``Report.backend_report``), or ``"auto"`` (pallas when eligible).
+    ``Report.backend_report``), ``"active_fused"`` (the fused Pallas
+    active kernel, ``ops.pallas_active`` — the same skip rule with
+    scalar-prefetched window streaming and in-kernel flag computation;
+    ``substeps`` requests composed-k passes and the report adds
+    ``flags_fused``/``composed_k``), or ``"auto"`` (pallas when
+    eligible).
     ``substeps`` batches that many model steps into each compiled step
     call (``Model.make_step``'s multi-step fusion — the HBM-amortizing
     fast path on TPU); any remainder of ``num_steps`` runs as single
@@ -189,7 +194,8 @@ class SerialExecutor:
         # (Main.cpp:32-33) at µs-step grids beat a NumPy loop this way
         # ("active" included: the point subsystem IS the ultimate
         # active-set optimization for all-point models)
-        if (self.step_impl in ("xla", "auto", "active") and num_steps > 0
+        if (self.step_impl in ("xla", "auto", "active", "active_fused")
+                and num_steps > 0
                 and model.flows
                 and all(isinstance(f, PointFlow) for f in model.flows)):
             from ..ops.point_kernel import build_point_plans, \
@@ -297,6 +303,93 @@ class SerialExecutor:
                 }
                 return out
 
+        # the amortized FUSED active runner (ops.pallas_active, ISSUE 8):
+        # the active engine's loop shape with the gather/compute/flags
+        # replaced by the scalar-prefetched Pallas pass — flags are
+        # computed in-kernel, and ``substeps`` requests composed-k
+        # passes (k auto-chosen dividing it). Same eligibility rule as
+        # the XLA active runner; ineligible models drop to the generic
+        # loop whose make_step raises the clean errors.
+        if self.step_impl == "active_fused" and num_steps > 0:
+            rates = model.pallas_rates()
+            live = {a: r for a, r in (rates or {}).items() if r != 0.0}
+            if (rates is not None and live
+                    and not any(isinstance(f, PointFlow)
+                                for f in model.flows)
+                    and all(jnp.issubdtype(space.values[a].dtype,
+                                           jnp.floating)
+                            and space.values[a].dtype == jnp.dtype(
+                                space.dtype)
+                            for a in live)):
+                key = ("fusedrun", space.shape, space.global_shape,
+                       (space.x_init, space.y_init), str(space.dtype),
+                       model.offsets, tuple(sorted(live.items())),
+                       self.substeps,
+                       tuple(sorted((self.active_opts or {}).items())))
+                entry = self._cache.get(key)
+                if entry is None:
+                    from ..ops.pallas_active import (build_fused_runner,
+                                                     choose_fused_k)
+                    from ..ops.active import plan_for
+                    from ..ops.pallas_stencil import resolve_interpret
+
+                    opts = dict(self.active_opts or {})
+                    plan = plan_for(
+                        space.shape, tile=opts.get("tile"),
+                        capacity=opts.get("capacity"),
+                        max_active_frac=opts.get("max_active_frac", 0.25))
+                    k = choose_fused_k(self.substeps, plan)
+                    dense_fns = {}
+                    for a, r in live.items():
+                        fn = model._probe_pallas_dense(space, r,
+                                                       self.compute_dtype)
+                        if fn is not None:
+                            dense_fns[a] = fn
+                    interp = resolve_interpret(
+                        next(iter(space.values.values())))
+                    run = jax.jit(build_fused_runner(
+                        space.shape, live, model.offsets, space.dtype,
+                        origin=(space.x_init, space.y_init),
+                        global_shape=space.global_shape, plan=plan, k=k,
+                        dense_fns=dense_fns, track_dirty=True,
+                        interpret=interp))
+                    entry = (run, plan, k)
+                    self._cache[key] = entry
+                run, plan, k = entry
+                out, (fb, at, ff, dirty) = run(dict(space.values),
+                                               jnp.int32(num_steps))
+                self.last_impl = "active_fused"
+                self.last_dirty_tiles = {
+                    "tile": plan.tile, "grid": plan.grid,
+                    "map": np.asarray(dirty),
+                }
+                from ..ops.pallas_active import pass_count
+
+                nattr = len(live)
+                passes = pass_count(num_steps, k)
+                self.last_backend_report = {
+                    "impl": "active_fused",
+                    "steps": int(num_steps),
+                    "composed_k": k,
+                    "passes": passes,
+                    #: (attr, pass) pairs that ran the dense fallback
+                    "fallback_steps": int(fb),
+                    #: (attr, pass) pairs whose next-step flags came out
+                    #: of the kernel — the in-kernel flag counter the
+                    #: observability satellite tracks (fallback passes
+                    #: recompute flags in XLA, so flags_fused +
+                    #: fallback_steps == passes × live attrs)
+                    "flags_fused": int(ff),
+                    "tile": list(plan.tile),
+                    "tiles": plan.ntiles,
+                    "capacity": plan.capacity,
+                    "fallback_tiles": plan.fallback_tiles,
+                    "mean_active_fraction": (
+                        float(at) / (passes * nattr * plan.ntiles)
+                        if passes and nattr else None),
+                }
+                return out
+
         # q multi-step calls + r single-step calls == num_steps steps
         q, r = divmod(num_steps, self.substeps)
         stepk = model.make_step(space, impl=self.step_impl,
@@ -309,6 +402,18 @@ class SerialExecutor:
         step_any = stepk or step1
         # num_steps=0 builds no step at all — nothing ran, report None
         self.last_impl = step_any.impl if step_any is not None else None
+        if step_any is not None and step_any.impl == "active_fused":
+            # the stateless fused form (point-flow compositions land
+            # here): k visibility mirrors the composed record — the
+            # amortized runner above reports the full counter set
+            self.last_backend_report = {
+                "impl": "active_fused",
+                "substeps": self.substeps,
+                "composed_k": getattr(stepk or step1, "composed_k", None),
+                "composed_passes_per_call": getattr(
+                    stepk or step1, "composed_passes", None),
+                "remainder_steps": r,
+            }
         if step_any is not None and step_any.impl == "composed":
             # auto-k visibility (ISSUE 3 satellite): the chosen k and
             # the remainder chunk's depth land in Report.backend_report,
@@ -425,6 +530,42 @@ class Model:
             return None
         return stepper
 
+    def _active_live_rates(self, space: CellularSpace,
+                           impl: str) -> dict[str, float]:
+        """Shared eligibility gate of the active-tile impls (XLA
+        ``"active"`` and fused ``"active_fused"``): all-Diffusion field
+        flows (the tile-skip rule is only bitwise-exact for uniform-rate
+        linear flows), at least one nonzero rate, every live channel in
+        the space dtype. Returns the live attr → rate map; raises the
+        clean errors the tests and executors match on."""
+        rates = self.pallas_rates()
+        if rates is None:
+            raise ValueError(
+                f"impl='{impl}' requires all field flows to be plain "
+                "Diffusion (the tile-skip rule is only bitwise-exact "
+                "for uniform-rate linear flows); got "
+                f"flows={[type(f).__name__ for f in self.flows]}. "
+                "Use impl='xla'/'auto'.")
+        live = {a: r for a, r in rates.items() if r != 0.0}
+        if rates and not live:
+            raise ValueError(
+                f"impl='{impl}' has nothing to step: every Diffusion "
+                "rate is 0.0 (no field transport). Use "
+                "impl='xla'/'auto' for a no-op field step.")
+        if not rates:
+            raise ValueError(
+                f"impl='{impl}' needs a Diffusion field flow; "
+                "all-point models already take the point-subsystem "
+                "fast path (the executors route them automatically).")
+        for a in live:
+            adt = space.values[a].dtype
+            if adt != jnp.dtype(space.dtype):
+                raise ValueError(
+                    f"impl='{impl}' computes every flow channel in "
+                    f"the space dtype ({jnp.dtype(space.dtype).name});"
+                    f" channel {a!r} is {adt}. Use impl='xla'.")
+        return live
+
     @staticmethod
     def pallas_dtype_ok(space: CellularSpace) -> bool:
         """Pallas kernels compute in f32 internally; f64 grids stay on
@@ -485,7 +626,8 @@ class Model:
                     f"{ch.dtype} for channel {f.attr!r} (integer/bool "
                     "channels are supported for storage/comm/masks, "
                     "not flows)")
-        if impl not in ("xla", "pallas", "auto", "composed", "active"):
+        if impl not in ("xla", "pallas", "auto", "composed", "active",
+                        "active_fused"):
             raise ValueError(f"unknown step impl {impl!r}")
         substeps = int(substeps)
         if substeps < 1:
@@ -577,37 +719,50 @@ class Model:
             # dense fallback the same step above the capacity/activity
             # threshold. Point flows compose (they fire after the field
             # step; activity is recomputed from the values each call).
-            rates = self.pallas_rates()
-            if rates is None:
-                raise ValueError(
-                    "impl='active' requires all field flows to be plain "
-                    "Diffusion (the tile-skip rule is only bitwise-exact "
-                    "for uniform-rate linear flows); got "
-                    f"flows={[type(f).__name__ for f in self.flows]}. "
-                    "Use impl='xla'/'auto'.")
-            live = {a: r for a, r in rates.items() if r != 0.0}
-            if rates and not live:
-                raise ValueError(
-                    "impl='active' has nothing to step: every Diffusion "
-                    "rate is 0.0 (no field transport). Use "
-                    "impl='xla'/'auto' for a no-op field step.")
-            if not rates:
-                raise ValueError(
-                    "impl='active' needs a Diffusion field flow; "
-                    "all-point models already take the point-subsystem "
-                    "fast path (the executors route them automatically).")
-            for a in live:
-                adt = space.values[a].dtype
-                if adt != jnp.dtype(space.dtype):
-                    raise ValueError(
-                        "impl='active' computes every flow channel in "
-                        f"the space dtype ({jnp.dtype(space.dtype).name});"
-                        f" channel {a!r} is {adt}. Use impl='xla'.")
+            live = self._active_live_rates(space, "active")
             from ..ops.active import ActiveDiffusionStep
             active_steppers = {
                 attr: ActiveDiffusionStep(
                     space.shape, rate, dtype=space.dtype, offsets=offsets,
                     origin=origin, global_shape=space.global_shape,
+                    dense_fn=self._probe_pallas_dense(space, rate,
+                                                      compute_dtype))
+                for attr, rate in live.items()}
+        fused_steppers = None
+        fused_k = None
+        fused_passes = None
+        if impl == "active_fused":
+            # the fused Pallas active-tile kernel (ops.pallas_active,
+            # ISSUE 8): scalar-prefetched sparse streaming with in-kernel
+            # flag computation; substeps > 1 composes k flow steps per
+            # tile-resident pass (k auto-chosen dividing substeps, the
+            # impl="composed" contract — a point flow must fire between
+            # sub-steps, so substeps > 1 disqualifies point-flow models).
+            live = self._active_live_rates(space, "active_fused")
+            if substeps > 1 and pt_by_attr:
+                raise ValueError(
+                    "impl='active_fused' with substeps > 1 composes the "
+                    "sub-steps inside the kernel pass; a point flow must "
+                    "fire between sub-steps. Use substeps=1 or drop the "
+                    "point flows.")
+            from ..ops.pallas_active import (FusedActiveStep,
+                                            choose_fused_k, plan_for)
+            from ..ops.pallas_stencil import resolve_interpret
+            interp = resolve_interpret(next(iter(space.values.values())))
+            fused_k = choose_fused_k(substeps, plan_for(space.shape))
+            fused_passes = substeps // fused_k
+            if fused_k == 1 and substeps > 1:
+                warnings.warn(
+                    f"impl='active_fused' auto-k degenerated to k=1 for "
+                    f"substeps={substeps} (no divisor fits the tile "
+                    "geometry): each pass advances one step, equaling "
+                    "the k=1 fused path. Pick substeps with a small "
+                    "divisor to actually compose.", RuntimeWarning)
+            fused_steppers = {
+                attr: FusedActiveStep(
+                    space.shape, rate, dtype=space.dtype, offsets=offsets,
+                    origin=origin, global_shape=space.global_shape,
+                    k=fused_k, passes=fused_passes, interpret=interp,
                     dense_fn=self._probe_pallas_dense(space, rate,
                                                       compute_dtype))
                 for attr, rate in live.items()}
@@ -740,6 +895,11 @@ class Model:
                 # composed discipline)
                 for attr, stepper in active_steppers.items():
                     new[attr] = stepper(values[attr])
+            elif fused_steppers is not None:
+                # the fused Pallas active pass — each call advances
+                # passes * k = substeps flow steps per channel
+                for attr, stepper in fused_steppers.items():
+                    new[attr] = stepper(values[attr])
             else:
                 outflow = build_outflow(field_flows, values, origin)
                 for attr, o in outflow.items():
@@ -757,7 +917,8 @@ class Model:
 
         if (substeps == 1 or pallas_steppers is not None
                 or pallas_field_stepper is not None
-                or composed_steppers is not None):
+                or composed_steppers is not None
+                or fused_steppers is not None):
             step = single
         else:
             def step(values: Values) -> Values:
@@ -767,18 +928,23 @@ class Model:
 
         # which field-flow kernel the step actually uses (after any auto
         # fallback) — callers like bench report it
-        step.impl = ("active" if active_steppers is not None
+        step.impl = ("active_fused" if fused_steppers is not None
+                     else "active" if active_steppers is not None
                      else "composed" if composed_steppers is not None
                      else "pallas" if (pallas_steppers is not None
                                        or pallas_field_stepper is not None)
                      else "xla")
         step.substeps = substeps
         # auto-k visibility (ISSUE 3 satellite): the chosen composed k
-        # rides the step so executors/Reports can record it
+        # rides the step so executors/Reports can record it — the fused
+        # active impl composes the same way (k·passes == substeps, the
+        # jaxpr-halo audit contract)
         step.composed_k = (next(iter(composed_steppers.values())).k
-                           if composed_steppers is not None else None)
+                           if composed_steppers is not None
+                           else fused_k)
         step.composed_passes = (composed_passes
-                                if composed_steppers is not None else None)
+                                if composed_steppers is not None
+                                else fused_passes)
         self._step_cache[key] = step
         return step
 

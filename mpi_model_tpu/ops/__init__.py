@@ -6,6 +6,12 @@ from .composed_stencil import (
     composed_taps,
 )
 from .flow import Coupled, Diffusion, Exponencial, Flow, PointFlow, build_outflow
+from .pallas_active import (
+    FusedActiveStep,
+    build_fused_runner,
+    choose_fused_k,
+    fused_active_pass,
+)
 from .pallas_stencil import (
     PallasDiffusionStep,
     PallasFieldStep,
@@ -36,4 +42,8 @@ __all__ = [
     "composed_halo_step",
     "composed_taps",
     "choose_k",
+    "FusedActiveStep",
+    "build_fused_runner",
+    "choose_fused_k",
+    "fused_active_pass",
 ]

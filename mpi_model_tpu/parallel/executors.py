@@ -159,8 +159,11 @@ class ShardMapExecutor:
     ``halo_depth``-deep ring: interior tiles run ONE
     ``(2·halo_depth+1)²`` tap pass per exchange instead of
     ``halo_depth`` iterated steps — all-Diffusion models only, raises
-    otherwise; see ``ops.composed_stencil``), or ``"auto"`` (pallas
-    when eligible and its compile succeeds, else xla).
+    otherwise; see ``ops.composed_stencil``), ``"active"`` /
+    ``"active_fused"`` (shard-local active-tile stepping — the XLA
+    engine or the fused Pallas kernel over the same ghost-padded
+    windows; ``_build_active_runner``), or ``"auto"`` (pallas when
+    eligible and its compile succeeds, else xla).
     """
 
     def __init__(self, mesh: Mesh, step_impl: str = "xla",
@@ -168,16 +171,17 @@ class ShardMapExecutor:
                  compute_dtype=None):
         if len(mesh.axis_names) not in (1, 2):
             raise ValueError("ShardMapExecutor needs a 1-D or 2-D mesh")
-        if step_impl not in ("xla", "pallas", "auto", "composed", "active"):
+        if step_impl not in ("xla", "pallas", "auto", "composed", "active",
+                             "active_fused"):
             raise ValueError(f"unknown step impl {step_impl!r}")
         if halo_mode not in ("exchange", "zero"):
             raise ValueError(f"unknown halo mode {halo_mode!r}")
         if int(halo_depth) < 1:
             raise ValueError(f"halo_depth must be >= 1, got {halo_depth}")
-        if step_impl == "active" and int(halo_depth) != 1:
+        if step_impl in ("active", "active_fused") and int(halo_depth) != 1:
             raise ValueError(
-                "step_impl='active' exchanges a one-cell ghost ring per "
-                f"step; halo_depth={halo_depth} is not supported (the "
+                f"step_impl={step_impl!r} exchanges a one-cell ghost ring "
+                f"per step; halo_depth={halo_depth} is not supported (the "
                 "active set would need depth-d frontier dilation)")
         self.mesh = mesh
         self.step_impl = step_impl
@@ -340,7 +344,8 @@ class ShardMapExecutor:
         # deltas mean NO halo traffic at all; owned entries scatter back
         # once per run. Bitwise equal to the halo path.
         if (self.halo_depth == 1
-                and self.step_impl in ("xla", "auto", "active")
+                and self.step_impl in ("xla", "auto", "active",
+                                       "active_fused")
                 and model.flows
                 and all(isinstance(f, PointFlow) for f in model.flows)):
             mkey = ("pointmini",) + key
@@ -372,19 +377,26 @@ class ShardMapExecutor:
         # like the interior dilation. The per-shard dense fallback
         # consumes the same exchanged ring (the exchange sits OUTSIDE
         # the cond: collectives must run on every shard every step).
-        if self.step_impl == "active":
-            akey = ("active", key)
+        if self.step_impl in ("active", "active_fused"):
+            fused = self.step_impl == "active_fused"
+            akey = (self.step_impl, key)
             entry = self._cache.get(akey)
             if entry is None:
-                with get_tracer().span("shardmap.build", impl="active"):
-                    entry = self._build_active_runner(model, space)
+                with get_tracer().span("shardmap.build",
+                                       impl=self.step_impl):
+                    entry = self._build_active_runner(model, space,
+                                                      fused=fused)
                 self._cache[akey] = entry
             runner, plan, nattr, nshards = entry
-            out, (fb, at) = runner(values, n)
-            self.last_impl = "active"
+            out, stats = runner(values, n)
+            if fused:
+                fb, at, ff = stats
+            else:
+                (fb, at), ff = stats, None
+            self.last_impl = self.step_impl
             ntiles = plan.ntiles * nshards
             self.last_backend_report = {
-                "impl": "active",
+                "impl": self.step_impl,
                 "steps": int(num_steps),
                 "shards": nshards,
                 #: (shard, attr, step) triples that ran the per-shard
@@ -400,6 +412,12 @@ class ShardMapExecutor:
                     float(at) / (num_steps * nattr * ntiles)
                     if num_steps and nattr else None),
             }
+            if fused:
+                #: (shard, attr, step) triples whose flags came out of
+                #: the kernel (psum'd) — fallbacks recompute flags in
+                #: XLA, so flags_fused + fallback_steps == the triple
+                #: total (the observability satellite's counter)
+                self.last_backend_report["flags_fused"] = int(ff)
             return out
 
         # one probe/build/cache protocol for both depths: the fused
@@ -829,7 +847,8 @@ class ShardMapExecutor:
                                 out_specs=spec, check_vma=False)
         return jax.jit(sharded)
 
-    def _build_active_runner(self, model, space: CellularSpace):
+    def _build_active_runner(self, model, space: CellularSpace,
+                             fused: bool = False):
         """Shard-local active-tile stepping (``ops.active``): per shard,
         per step — one ppermute value exchange (the ghost ring), tile
         activity = ring-1 dilation of the shard's nonzero-tile map OR'd
@@ -842,26 +861,36 @@ class ShardMapExecutor:
         recomputed here from the same operands with the same expression
         the owning shard uses.
 
+        ``fused=True`` (``step_impl="active_fused"``, ISSUE 8) swaps the
+        XLA gather/compute for the scalar-prefetched Pallas pass
+        (``ops.pallas_active.fused_active_pass``): windows stream the
+        SAME ghost-padded shard — ghost-flag activation, counts-from-
+        global-coordinates and the value-exchange bitwise argument all
+        carry over unchanged — and the next tile map comes from the
+        kernel's in-VMEM flags.
+
         Returns ``(runner, plan, nattr, nshards)``; the runner yields
-        ``(values, (fallback_events, active_tiles_total))`` with both
-        counters psum'd across shards (one cheap collective per run),
-        mirroring the serial runner's stats so a sharded run that
-        dense-fell-back every step is visible in
-        ``Report.backend_report``, not silently labeled "active"."""
+        ``(values, (fallback_events, active_tiles_total))`` — plus a
+        ``flags_fused`` counter under ``fused`` — with the counters
+        psum'd across shards (one cheap collective per run), mirroring
+        the serial runner's stats so a sharded run that dense-fell-back
+        every step is visible in ``Report.backend_report``, not
+        silently labeled "active"."""
         from jax import lax
 
         from ..ops import active as act
         from ..ops.stencil import neighbor_counts_traced
 
+        impl_name = "active_fused" if fused else "active"
         rates = model.pallas_rates()
         live = {a: r for a, r in (rates or {}).items() if r != 0.0}
         has_point = any(isinstance(f, PointFlow) for f in model.flows)
         if rates is None or not live or has_point:
             raise ValueError(
-                "step_impl='active' requires all field flows to be plain "
-                "Diffusion with a nonzero rate and no point flows (the "
-                "tile-skip rule is only bitwise-exact for uniform-rate "
-                "linear flows); got "
+                f"step_impl={impl_name!r} requires all field flows to be "
+                "plain Diffusion with a nonzero rate and no point flows "
+                "(the tile-skip rule is only bitwise-exact for "
+                "uniform-rate linear flows); got "
                 f"flows={[type(f).__name__ for f in model.flows]}. "
                 "Use step_impl='xla' or 'auto'.")
         for a in live:
@@ -872,8 +901,8 @@ class ShardMapExecutor:
                     f"{adt} for channel {a!r}")
             if adt != jnp.dtype(space.dtype):
                 raise ValueError(
-                    "step_impl='active' computes every flow channel in "
-                    f"the space dtype ({jnp.dtype(space.dtype).name}); "
+                    f"step_impl={impl_name!r} computes every flow channel "
+                    f"in the space dtype ({jnp.dtype(space.dtype).name}); "
                     f"channel {a!r} is {adt}. Use step_impl='xla'.")
         mesh = self.mesh
         names, nx, ny, local_h, local_w = self._shard_geometry(space)
@@ -894,6 +923,11 @@ class ShardMapExecutor:
         else:
             def pad(z):
                 return pad_with_halo_2d(z, names[0], names[1], nx, ny)
+
+        if fused:
+            from ..ops.pallas_active import fused_active_pass
+            from ..ops.pallas_stencil import mesh_interpret
+            interp = mesh_interpret(mesh)
 
         def shard_fn(values, n):
             row0 = np.int32(x_init) + lax.axis_index(names[0]) * np.int32(
@@ -934,50 +968,76 @@ class ShardMapExecutor:
                     p, u = args
                     new = act.dense_from_ghost_padded(
                         p, rate, counts_pad, offsets, dtype)
-                    return new, act.tile_nonzero_map(new, plan), u
+                    return (new, act.tile_nonzero_map(new, plan), u,
+                            jnp.zeros((), jnp.int32))
 
-                def active_branch(args):
+                def active_branch(args, _tmap=tmap):
                     p, u = args
                     ids, cnt = act.compact_tile_ids(flags, plan)
-                    p2, u2, anyf = act.active_pass(
-                        p, u, ids, cnt, rate, plan, (row0, col0), gshape,
-                        offsets, dtype)
+                    if fused:
+                        # the scalar-prefetched kernel pass: same
+                        # ghost-padded windows, flags computed in-VMEM
+                        selfnz = _tmap.reshape(-1)[ids].astype(jnp.int32)
+                        origin_vec = jnp.stack([row0, col0]).astype(
+                            jnp.int32)
+                        p2, anyf = fused_active_pass(
+                            p, ids, cnt, selfnz, rate, plan, origin_vec,
+                            gshape, offsets, dtype, k=1, ring=1,
+                            taps=None, interpret=interp)
+                        u2 = u
+                    else:
+                        p2, u2, anyf = act.active_pass(
+                            p, u, ids, cnt, rate, plan, (row0, col0),
+                            gshape, offsets, dtype)
                     return (p2[1:-1, 1:-1],
-                            act.next_tile_map(anyf, ids, cnt, plan), u2)
+                            act.next_tile_map(anyf, ids, cnt, plan), u2,
+                            jnp.ones((), jnp.int32))
 
-                nv, ntm, nu = lax.cond(pred, dense_branch, active_branch,
-                                       (padded, upd))
-                return nv, ntm, nu, pred, count
+                nv, ntm, nu, fs = lax.cond(pred, dense_branch,
+                                           active_branch, (padded, upd))
+                return nv, ntm, nu, pred, count, fs
 
-            upd0 = {a: jnp.zeros((plan.capacity, th, tw), dtype)
+            # the fused branch scatters in-kernel and never touches the
+            # carried update buffer — a scalar placeholder keeps the
+            # cond/loop carries shape-shared without allocating the
+            # [capacity, th, tw] buffer (~64 MB/attr at bench scale)
+            # the XLA branch actually needs
+            upd0 = {a: (jnp.zeros((), dtype) if fused
+                        else jnp.zeros((plan.capacity, th, tw), dtype))
                     for a in live}
             # one full-shard nonzero scan per RUN seeds the carried maps
             tmap0 = {a: act.tile_nonzero_map(values[a], plan)
                      for a in live}
 
             def body(i, carry):
-                vals, tmaps, upds, fb, at = carry
+                vals, tmaps, upds, fb, at, ff = carry
                 new_v, new_t, new_u = dict(vals), dict(tmaps), dict(upds)
                 for a, r in live.items():
-                    (new_v[a], new_t[a], new_u[a], p, c) = step_attr(
+                    (new_v[a], new_t[a], new_u[a], p, c, fs) = step_attr(
                         vals[a], tmaps[a], upds[a], r)
                     # serial-runner stats semantics (ops.active): fb
                     # counts dense-fallback EVENTS, at sums the dilated
-                    # active-tile counts — here per (shard, attr, step)
+                    # active-tile counts, ff the kernel-flagged steps —
+                    # here per (shard, attr, step)
                     fb = fb + p.astype(jnp.int32)
                     at = at + c.astype(jnp.float32)
-                return new_v, new_t, new_u, fb, at
+                    ff = ff + fs
+                return new_v, new_t, new_u, fb, at, ff
 
             # n is a TRACED scalar: one compile serves every step count
-            out, _, _, fb, at = lax.fori_loop(
+            out, _, _, fb, at, ff = lax.fori_loop(
                 0, n, body, (values, tmap0, upd0, jnp.int32(0),
-                             jnp.float32(0)))
-            # one collective for both counters (psum over the pair)
-            fb, at = lax.psum((fb, at), names)
+                             jnp.float32(0), jnp.int32(0)))
+            # one collective for all counters (psum over the tuple)
+            fb, at, ff = lax.psum((fb, at, ff), names)
+            if fused:
+                return out, (fb, at, ff)
             return out, (fb, at)
 
+        stat_spec = (P(), P(), P()) if fused else (P(), P())
         sharded = shard_map(shard_fn, mesh=mesh, in_specs=(spec, P()),
-                            out_specs=(spec, (P(), P())))
+                            out_specs=(spec, stat_spec),
+                            check_vma=False if fused else None)
         return jax.jit(sharded), plan, len(live), nx * ny
 
     def _build_runner(self, model, space: CellularSpace):

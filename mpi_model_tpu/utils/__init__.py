@@ -1,3 +1,4 @@
+from .compile_cache import configure_compile_cache, configured_dir
 from .metrics import (ThroughputCounter, interleaved_ab,
                       marginal_runner_time, marginal_runner_trials,
                       marginal_step_time, marginal_step_trials,
@@ -7,6 +8,8 @@ from .tracing import Span, Tracer, get_tracer, set_tracer, trace_span
 
 __all__ = [
     "ThroughputCounter",
+    "configure_compile_cache",
+    "configured_dir",
     "marginal_step_time",
     "marginal_step_trials",
     "median_spread",

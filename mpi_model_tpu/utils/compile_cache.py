@@ -1,0 +1,87 @@
+"""Persistent JAX compilation cache (cold-start elimination, slice 1 of
+ROADMAP direction 5).
+
+Every process restart recompiles every kernel — for the fused active
+path (ISSUE 8) that is two Pallas kernels per (plan, k, dtype) plus the
+runner loops, seconds each on a laptop and worse over a remote-compile
+tunnel. The JAX persistent compilation cache keys compiled executables
+by (HLO, jaxlib version, flags, device kind) and serves them across
+processes, so a machine pays each compile ONCE — a restarted service
+reaches full throughput on its first batch.
+
+``configure_compile_cache(dir)`` is the ONE place the knobs are set;
+the CLI's ``--compile-cache DIR`` flag, ``EnsembleService(
+compile_cache=...)`` and ``bench.enable_compile_cache`` all route here.
+The bar for entry is dropped to zero compile seconds / any entry size —
+on the CPU test rigs even the tiny kernels should populate, which is
+what the cross-process test asserts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: config knobs to apply: (name, value). Applied best-effort in order —
+#: an older jax missing a knob keeps the cache as a plain optimization.
+_KNOBS = (
+    ("jax_persistent_cache_min_compile_time_secs", 0),
+    ("jax_persistent_cache_min_entry_size_bytes", -1),
+)
+
+_configured: Optional[str] = None
+
+
+def configure_compile_cache(cache_dir: Optional[str]) -> Optional[str]:
+    """Point the JAX persistent compilation cache at ``cache_dir``
+    (created if missing) and lower the entry bars so every compile is
+    cached. Returns the directory actually configured, or None when
+    ``cache_dir`` is None/empty (explicitly disabled — the caller's
+    flag was not set) or the running jax has no cache support.
+
+    Idempotent: reconfiguring with the same directory is a no-op;
+    a DIFFERENT directory re-points the cache (jax allows updating the
+    config between compiles)."""
+    global _configured
+    if not cache_dir:
+        return None
+    cache_dir = os.path.abspath(os.path.expanduser(str(cache_dir)))
+    if _configured == cache_dir:
+        return cache_dir
+    import jax
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except (AttributeError, KeyError, ValueError, OSError) as e:
+        # the cache is an optimization, never a hard failure — but the
+        # caller ASKED for it, so a dir that can't be armed must warn
+        # (the CLI's errors-not-silent-no-ops rule), not vanish
+        import warnings
+        warnings.warn(
+            f"persistent compile cache at {cache_dir!r} could not be "
+            f"armed ({type(e).__name__}: {e}); every compile will be "
+            "paid per process", RuntimeWarning)
+        return None
+    for name, value in _KNOBS:
+        try:
+            jax.config.update(name, value)
+        except (AttributeError, KeyError, ValueError):
+            pass  # older jax without this knob
+    # jax memoizes its cache-used decision at the FIRST compile of the
+    # process; a process that compiled anything before this call (test
+    # rigs, library embedders) would silently keep the cache off —
+    # reset so the next compile re-initializes against the new dir
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
+    except (ImportError, AttributeError):
+        pass  # older jax: the dir config alone armed it
+    _configured = cache_dir
+    return cache_dir
+
+
+def configured_dir() -> Optional[str]:
+    """The directory the cache was last pointed at via
+    ``configure_compile_cache`` (None = never configured here)."""
+    return _configured

@@ -303,7 +303,7 @@ def test_rule_registry_is_complete():
                  "dtype-drift", "traced-branch", "heavy-test",
                  "bare-pragma", "parse-error",
                  "jaxpr-dtype", "jaxpr-callback", "jaxpr-consts",
-                 "jaxpr-halo"):
+                 "jaxpr-halo", "jaxpr-fused-flags"):
         assert want in RULES, want
     assert RULES["broad-except"].severity is Severity.ERROR
     assert RULES["dtype-drift"].severity is Severity.WARNING
@@ -397,8 +397,9 @@ def test_stencil_radius():
 
 # -- jaxpr audit: goldens over the four registered impls ----------------------
 
-def test_contracts_cover_all_four_impls():
-    assert set(CONTRACTS) == {"dense", "composed", "active", "ensemble"}
+def test_contracts_cover_all_registered_impls():
+    assert set(CONTRACTS) == {"dense", "composed", "active", "ensemble",
+                              "active_fused", "active_fused_runner"}
 
 
 def test_jaxpr_audit_dense_golden():

@@ -119,3 +119,66 @@ def test_prefers_new_names_when_present():
         assert isinstance(
             compat.tpu_compiler_params(vmem_limit_bytes=1),
             pltpu.CompilerParams)
+
+
+def test_prefetch_scalar_grid_spec_bridge_runs_interpreted():
+    # the fused active kernel's shape (ISSUE 8): a scalar-prefetched
+    # index buffer routing block writes — the bridge must hand back a
+    # grid spec pallas_call accepts in interpret mode
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(idx_ref, x_ref, o_ref):
+        i = pl.program_id(0)
+        o_ref[0] = x_ref[idx_ref[i]] * 2.0
+
+    spec = compat.prefetch_scalar_grid_spec(
+        num_scalar_prefetch=1, grid=(3,),
+        in_specs=[pl.BlockSpec(memory_space=compat.HBM)],
+        out_specs=pl.BlockSpec((1,), lambda i, idx: (i,)),
+        scratch_shapes=[])
+    idx = jnp.asarray([2, 0, 1], jnp.int32)
+    x = jnp.asarray([10.0, 20.0, 30.0])
+    got = pl.pallas_call(
+        kernel, grid_spec=spec,
+        out_shape=jax.ShapeDtypeStruct((3,), x.dtype),
+        interpret=True,
+    )(idx, x)
+    assert np.array_equal(np.asarray(got), [60.0, 20.0, 40.0])
+    if hasattr(pltpu, "PrefetchScalarGridSpec"):
+        assert isinstance(spec, pltpu.PrefetchScalarGridSpec)
+
+
+def test_literal_type_bridge_matches_jaxprs():
+    Literal = compat.literal_type()
+
+    def f(x):
+        return x + 1.5
+
+    closed = jax.make_jaxpr(f)(jnp.zeros((2,)))
+    lits = [v for eqn in closed.jaxpr.eqns for v in eqn.invars
+            if isinstance(v, Literal)]
+    assert lits  # the 1.5 reaches the add as a Literal invar
+
+
+def test_fused_active_kernel_through_the_bridges():
+    # the whole fused pass (scalar prefetch + HBM windows + aliased
+    # scatter) must run through compat on this jax — the 0.4.x-rig
+    # regression shape that motivated this suite
+    from mpi_model_tpu.core.cell import MOORE_OFFSETS
+    from mpi_model_tpu.ops import active as act
+    from mpi_model_tpu.ops import pallas_active as pact
+
+    plan = act.plan_for((32, 32), tile=(16, 16))
+    v = jnp.zeros((32, 32), jnp.float64).at[10, 10].set(1.5)
+    tmap = act.tile_nonzero_map(v, plan)
+    flags = act.dilate_tile_map(tmap)
+    ids, count = act.compact_tile_ids(flags, plan)
+    selfnz = tmap.reshape(-1)[ids].astype(jnp.int32)
+    padded, anyf = jax.jit(
+        lambda p, i, c, s: pact.fused_active_pass(
+            p, i, c, s, 0.1, plan, jnp.zeros((2,), jnp.int32), (32, 32),
+            MOORE_OFFSETS, jnp.float64))(jnp.pad(v, 1), ids, count,
+                                         selfnz)
+    out = np.asarray(padded)[1:-1, 1:-1]
+    assert out[10, 10] != 0.0 and out.sum() == pytest.approx(1.5)
