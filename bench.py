@@ -510,6 +510,163 @@ def bench_ensemble(grid: int = 4096, B: int = 8, steps: int = 8,
     return row
 
 
+def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
+                  dtype_name: str = "float32", n_scenarios: int = 2000,
+                  arrival_rate_hz: Optional[float] = None,
+                  deadline_s: Optional[float] = None,
+                  max_queue: int = 256, windows: int = 2,
+                  chaos: bool = True, verbose: bool = False) -> dict:
+    """Always-on serving soak (ISSUE 9): an open-loop arrival process
+    drives ``n_scenarios`` scenarios through the async dispatch loop
+    (``AsyncEnsembleService`` — double-buffered launch/finish, donated
+    inter-window state, bounded admission) WITH the chaos harness armed
+    (transient lane poison, a whole-batch fault, a dispatch-thread
+    exception, a slow compile, a fetch poison, a forced queue-full
+    shed), and reports what a deployment lives on: sustained
+    scenarios/s, p50/p99 queue latency, device occupancy, and the
+    complete shed/expired/recovered/quarantined ledger — the run
+    ABORTS if any ticket fails to resolve (zero silent drops).
+
+    Preamble gate (before any timing): the SAME scenario batch served
+    through the async loop and the synchronous scheduler must match
+    bitwise at the timed geometry. The synchronous baseline then drives
+    the identical arrival schedule inline, so the occupancy comparison
+    is apples-to-apples. ``arrival_rate_hz=None`` calibrates the
+    offered load to ~90% of the sync path's measured service rate."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from mpi_model_tpu import CellularSpace, Diffusion, Model
+    from mpi_model_tpu.ensemble import (AsyncEnsembleService,
+                                        EnsembleService, buckets_for,
+                                        run_soak)
+    from mpi_model_tpu.resilience.inject import Fault, FaultPlan, armed
+
+    enable_compile_cache()
+    dtype = jnp.dtype(dtype_name)
+    rng = np.random.default_rng(17)
+    base = rng.uniform(0.5, 2.0, (grid, grid)).astype(np.float32)
+    pool_spaces, pool_models = [], []
+    for i in range(B):
+        v = jnp.asarray(np.roll(base, 11 * i, axis=0), dtype)
+        pool_spaces.append(CellularSpace.create(grid, grid, 1.0,
+                                                dtype=dtype)
+                           .with_values({"value": v}))
+        pool_models.append(
+            Model(Diffusion(RATE * (1.0 + 0.05 * i / max(B - 1, 1))),
+                  1.0, 1.0))
+    template = pool_models[0]
+    kwargs = dict(steps=steps, impl="xla", buckets=buckets_for(B),
+                  retry="solo")
+
+    # -- preamble gate: async-served == sync-served, bitwise, at the
+    # timed geometry (the f64 gate lives in tests/test_serving.py)
+    sync_gate = EnsembleService(template, **kwargs)
+    ts = [sync_gate.submit(pool_spaces[i], model=pool_models[i])
+          for i in range(B)]
+    sync_gate.flush()
+    want = [sync_gate.result(t)[0] for t in ts]
+    with AsyncEnsembleService(template, windows=windows,
+                              max_queue=max_queue, **kwargs) as gate_svc:
+        ta = [gate_svc.submit(pool_spaces[i], model=pool_models[i])
+              for i in range(B)]
+        got = [gate_svc.result(t, timeout=600)[0] for t in ta]
+    for i in range(B):
+        if not np.array_equal(np.asarray(got[i].values["value"]),
+                              np.asarray(want[i].values["value"])):
+            raise AssertionError(
+                f"service gate failed: async-served scenario {i} is not "
+                f"bitwise-equal to the synchronous scheduler at {grid}^2")
+    if verbose:
+        print(f"  service gate OK: {B} async lanes bitwise-equal to "
+              f"sync at {grid}^2 {dtype_name}", file=sys.stderr)
+
+    # -- offered load: ~90% of the sync path's measured service rate
+    gst = sync_gate.stats()
+    per_scen = (gst["busy_s"] / gst["scenarios"]
+                if gst["scenarios"] else 0.01)
+    rate = (arrival_rate_hz if arrival_rate_hz is not None
+            else 0.9 / max(per_scen, 1e-6))
+    scenarios = [(pool_spaces[i % B], pool_models[i % B], steps)
+                 for i in range(n_scenarios)]
+
+    # -- synchronous baseline: identical arrival schedule, inline
+    # dispatch on the arrival thread
+    sync_svc = EnsembleService(template, **kwargs)
+    sync_rep = run_soak(sync_svc, scenarios, arrival_rate_hz=rate)
+
+    # -- the async soak, chaos armed: transient + loop-level faults
+    # spread through the run; every one must resolve to a counted
+    # outcome (recovered / quarantined / shed / expired)
+    plan = FaultPlan((
+        Fault("lane_nan", ticket=max(1, n_scenarios // 3), once=True),
+        Fault("batch_exc", at=max(2, n_scenarios // (2 * B))),
+        Fault("thread_exc", at=3),
+        Fault("slow_compile", at=5, seconds=0.01),
+        Fault("fetch_nan", at=max(3, n_scenarios // (2 * B)) + 4,
+              lane=0, once=True),
+        Fault("queue_full", at=max(4, n_scenarios // 2)),
+    ), seed=23) if chaos else FaultPlan(())
+    async_svc = AsyncEnsembleService(
+        template, windows=windows, max_queue=max_queue,
+        deadline_s=deadline_s, **kwargs)
+    with armed(plan) as arm_state, async_svc:
+        async_rep = run_soak(async_svc, scenarios, arrival_rate_hz=rate)
+    fired = [f["kind"] for f in arm_state.fired]
+    if not async_rep["ledger_complete"]:
+        raise AssertionError(
+            "service soak dropped tickets silently: "
+            f"served {async_rep['served']} + failed "
+            f"{async_rep['failed']} + expired {async_rep['expired']} + "
+            f"shed {async_rep['shed']} != offered {async_rep['offered']}")
+    # donation honesty from the (bounded) dispatch log: every windowed
+    # dispatch still in the log must have carried its state copy-free
+    logged = [d for d in async_svc.scheduler.dispatch_log
+              if "windows" in d]
+    donation_ok = bool(logged) and all(
+        d["donated_windows"] == d["windows"] for d in logged)
+    occ_ratio = (async_rep["occupancy"] / sync_rep["occupancy"]
+                 if sync_rep["occupancy"] else None)
+    if verbose:
+        print(f"  soak: {async_rep['sustained_scenarios_per_s']:.2f} "
+              f"scen/s sustained (sync "
+              f"{sync_rep['sustained_scenarios_per_s']:.2f}), p99 "
+              f"{async_rep['latency_p99_s']:.3f}s, occupancy "
+              f"{async_rep['occupancy']:.2f} vs sync "
+              f"{sync_rep['occupancy']:.2f}, chaos fired={fired}",
+              file=sys.stderr)
+    return {
+        "metric": f"service soak scenarios/s ({n_scenarios}x {grid}^2 "
+                  f"{dtype_name}, {steps} steps/scenario, open-loop "
+                  f"@{rate:.1f}/s, chaos={'on' if chaos else 'off'})",
+        "grid": grid, "ensemble_B": B, "steps": steps,
+        "n_scenarios": n_scenarios, "windows": windows,
+        "max_queue": max_queue, "deadline_s": deadline_s,
+        "arrival_rate_hz": rate,
+        "sustained_scenarios_per_s":
+            async_rep["sustained_scenarios_per_s"],
+        "latency_p50_s": async_rep["latency_p50_s"],
+        "latency_p99_s": async_rep["latency_p99_s"],
+        "occupancy": async_rep["occupancy"],
+        "sync_occupancy": sync_rep["occupancy"],
+        "occupancy_vs_sync": occ_ratio,
+        "sync_scenarios_per_s": sync_rep["sustained_scenarios_per_s"],
+        "served": async_rep["served"], "failed": async_rep["failed"],
+        "expired": async_rep["expired"], "shed": async_rep["shed"],
+        "ledger_complete": async_rep["ledger_complete"],
+        "batch_occupancy": async_rep["batch_occupancy"],
+        "compile_cache_hit_rate": async_rep["compile_cache_hit_rate"],
+        "dispatches": async_rep["dispatches"],
+        "solo_retries": async_rep["solo_retries"],
+        "recovered_failures": async_rep["recovered_failures"],
+        "quarantined": async_rep["quarantined"],
+        "loop_faults": async_rep["loop_faults"],
+        "degraded_from": async_rep["degraded_from"],
+        "chaos_fired": fired,
+        "donation_ok": donation_ok,
+    }
+
+
 def _active_workload(grid: int, frac: float, dtype, rng):
     """Point-source wavefront covering ~``frac`` of the domain: a zero
     ocean with a centered random square of side ``grid*sqrt(frac)`` —
@@ -1124,6 +1281,14 @@ if __name__ == "__main__":
             # work, no chip required (the active executor steps the
             # workload on whatever backend is present)
             result = bench_checkpoint(verbose="-v" in sys.argv)
+        elif "--serve" in sys.argv:
+            # the always-on serving soak (ISSUE 9): open-loop arrivals
+            # with chaos armed; also persists the row as the round's
+            # BENCH_SERVE artifact
+            result = bench_service(verbose="-v" in sys.argv)
+            with open("BENCH_SERVE_r01.json", "w") as fh:
+                json.dump(result, fh, indent=2)
+                fh.write("\n")
         else:
             result = bench(verbose="-v" in sys.argv)
     # analysis: ignore[broad-except] — single-line contract: the driver

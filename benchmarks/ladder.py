@@ -805,8 +805,28 @@ def config8(quick: bool = False) -> dict:
             **row}
 
 
+def config9(quick: bool = False) -> dict:
+    """Always-on serving soak (ISSUE 9): the async dispatch loop under
+    an open-loop arrival process WITH chaos armed — sustained
+    scenarios/s, p50/p99 queue latency, device occupancy (in-flight
+    fraction, vs the synchronous inline-dispatch baseline on the same
+    arrival schedule) and the shed/expired/recovered/quarantined
+    ledger. The preamble gates async-vs-sync bitwise at the row's
+    geometry; the row aborts if any ticket resolves silently."""
+    import bench as bench_mod
+
+    g = 64 if quick else 512
+    row = bench_mod.bench_service(
+        grid=g, B=4 if quick else 8, steps=4 if quick else 8,
+        n_scenarios=40 if quick else 2000,
+        windows=2)
+    return {"config": 9, "flow": "diffusion (per-scenario rates)",
+            "strategy": "always-on async serving soak (chaos armed)",
+            **row}
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8}
+           6: config6, 7: config7, 8: config8, 9: config9}
 
 
 def sweep_blocks(grid: int = 8192, dtype_name: str = "bfloat16") -> list:

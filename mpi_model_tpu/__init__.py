@@ -29,11 +29,14 @@ from .resilience import (
     supervised_run,
 )
 from .ensemble import (
+    AsyncEnsembleService,
     EnsembleConservationError,
     EnsembleExecutor,
     EnsembleScheduler,
     EnsembleService,
     EnsembleSpace,
+    ServiceOverloaded,
+    TicketExpired,
 )
 
 __version__ = "0.1.0"
@@ -58,10 +61,13 @@ __all__ = [
     "SimulationFailure",
     "check_health",
     "supervised_run",
+    "AsyncEnsembleService",
     "EnsembleConservationError",
     "EnsembleExecutor",
     "EnsembleScheduler",
     "EnsembleService",
+    "ServiceOverloaded",
+    "TicketExpired",
     "EnsembleSpace",
     "__version__",
 ]

@@ -101,6 +101,25 @@ def _parse_chaos(args):
     return FaultPlan((Fault(kind, at=at),), seed=args.chaos_seed)
 
 
+def _cache_spec(args, default):
+    """Map ``--compile-cache`` to the service knob: unset → ``default``
+    ("auto" on the ensemble/serve paths — the cache rides under the
+    scheduler by default; None on the single-run path, where it stays
+    opt-in), ``off``/``none`` → explicitly disabled, a directory →
+    that directory; an EMPTY value is an error, not a silent flip
+    (the errors-not-silent-no-ops rule)."""
+    v = args.compile_cache
+    if v is None:
+        return default
+    if v.strip().lower() in ("off", "none"):
+        return None
+    if not v.strip():
+        raise SystemExit(
+            "--compile-cache needs a directory (or 'off' to disable "
+            "the persistent cache explicitly)")
+    return v
+
+
 def _compute_dtype(args):
     if args.compute_dtype is None:
         return None
@@ -234,7 +253,7 @@ def _run_ensemble(args, space, model) -> int:
         model, steps=steps, impl=args.ensemble_impl,
         substeps=args.substeps, buckets=buckets_for(B),
         compute_dtype=_compute_dtype(args), check_conservation=False,
-        compile_cache=args.compile_cache)
+        compile_cache=_cache_spec(args, "auto"))
     t0 = _time.perf_counter()
     try:
         tickets = [svc.submit(space) for _ in range(B)]
@@ -292,6 +311,58 @@ def _run_ensemble(args, space, model) -> int:
     return 0 if conserved else 1
 
 
+def _run_serve(args, space, model) -> int:
+    """``--serve``: drive the always-on async dispatch loop (ISSUE 9)
+    with an open-loop arrival process — ``--serve-scenarios`` copies of
+    the configured scenario arriving at ``--arrival-rate`` per second
+    (0/unset = open throttle) against a ``--max-queue``-bounded
+    admission queue with optional per-ticket ``--deadline-s``. Reports
+    the serving ledger (served/failed/expired/shed — complete by
+    construction, exit 1 if not), sustained scenarios/s, p50/p99 queue
+    latency and device occupancy."""
+    from .ensemble import AsyncEnsembleService, buckets_for, run_soak
+
+    steps = args.steps if args.steps is not None else model.num_steps
+    n = args.serve_scenarios
+    svc = AsyncEnsembleService(
+        model, steps=steps, impl=args.ensemble_impl,
+        substeps=args.substeps, buckets=buckets_for(8),
+        max_queue=args.max_queue, compute_dtype=_compute_dtype(args),
+        deadline_s=args.deadline_s, retry="solo",
+        compile_cache=_cache_spec(args, "auto"))
+    rate = args.arrival_rate if args.arrival_rate else 1e9
+    with svc:
+        rep = run_soak(svc, [(space, None, None)] * n,
+                       arrival_rate_hz=rate)
+    result = {
+        "backend": "serve",
+        "impl": args.ensemble_impl,
+        "steps": steps,
+        "max_queue": args.max_queue,
+        "deadline_s": args.deadline_s,
+        **{k: rep[k] for k in (
+            "offered", "served", "failed", "expired", "shed",
+            "ledger_complete", "wall_s", "sustained_scenarios_per_s",
+            "occupancy", "latency_p50_s", "latency_p99_s",
+            "batch_occupancy", "dispatches", "solo_retries",
+            "recovered_failures", "quarantined", "loop_faults")},
+    }
+    if args.json:
+        print(json.dumps(result, allow_nan=False))
+    else:
+        sps = rep["sustained_scenarios_per_s"]
+        p99 = rep["latency_p99_s"]
+        p99_s = "n/a" if p99 is None else f"{p99:.4f}s"
+        print(f"backend=serve impl={args.ensemble_impl} "
+              f"served={rep['served']}/{rep['offered']} "
+              f"shed={rep['shed']} expired={rep['expired']} "
+              f"failed={rep['failed']} "
+              f"({sps:.1f} scenarios/s sustained, "
+              f"p99={p99_s}, "
+              f"occupancy={rep['occupancy']:.2f})")
+    return 0 if rep["ledger_complete"] else 1
+
+
 def cmd_run(args) -> int:
     import time as _time
 
@@ -299,8 +370,9 @@ def cmd_run(args) -> int:
     from .utils.tracing import get_tracer
 
     # arm the persistent compilation cache BEFORE anything compiles —
-    # idempotent, and None (flag unset) leaves jax untouched
-    configure_compile_cache(args.compile_cache)
+    # idempotent; on the single-run path an unset flag leaves jax
+    # untouched (the ensemble/serve paths default to "auto" instead)
+    configure_compile_cache(_cache_spec(args, None))
 
     # inapplicable flag combinations are errors, not silent no-ops — a
     # user must not believe they benchmarked a configuration that never
@@ -343,6 +415,52 @@ def cmd_run(args) -> int:
                          "--mesh/--rectangular")
     if args.channels != 2 and args.flow != "coupled":
         raise SystemExit("--channels applies to --flow=coupled")
+    if args.serve:
+        if args.ensemble is not None:
+            raise SystemExit(
+                "--serve runs the always-on async loop over an arrival "
+                "process; --ensemble runs one synchronous batch — pick "
+                "one")
+        if sharded:
+            raise SystemExit(
+                "--serve batches whole scenarios through the ensemble "
+                "engine (the batch axis replaces the mesh axes); drop "
+                "--mesh/--rectangular")
+        if args.chaos is not None:
+            raise SystemExit(
+                "--chaos drives the single-run supervised path; serve-"
+                "mode chaos is driven from the API (resilience.inject "
+                "armed around run_soak — see bench.bench_service)")
+        if args.checkpoint_dir is not None or args.output is not None:
+            raise SystemExit(
+                "--serve does not compose with --checkpoint-dir/"
+                "--output (supervised/dump runs are single-scenario)")
+        if args.impl != "auto":
+            raise SystemExit(
+                "--impl selects the single-run kernel; serve mode uses "
+                "--ensemble-impl=xla|pipeline|active|active_fused")
+        if args.serve_scenarios < 1:
+            raise SystemExit(
+                f"--serve-scenarios={args.serve_scenarios} needs >= 1")
+        if args.max_queue < 1:
+            raise SystemExit(f"--max-queue={args.max_queue} needs >= 1")
+        if args.arrival_rate is not None and args.arrival_rate < 0:
+            raise SystemExit(
+                f"--arrival-rate={args.arrival_rate} must be >= 0 "
+                "(0 = open throttle)")
+        if args.deadline_s is not None and args.deadline_s <= 0:
+            raise SystemExit(
+                f"--deadline-s={args.deadline_s} must be positive")
+    else:
+        for flag, val, default in (
+                ("--arrival-rate", args.arrival_rate, None),
+                ("--deadline-s", args.deadline_s, None),
+                ("--max-queue", args.max_queue, 64),
+                ("--serve-scenarios", args.serve_scenarios, 64)):
+            if val != default:
+                raise SystemExit(
+                    f"{flag} configures the always-on serving loop; "
+                    "add --serve")
     if args.ensemble is not None:
         if args.ensemble < 1:
             raise SystemExit(f"--ensemble={args.ensemble} needs B >= 1")
@@ -369,9 +487,9 @@ def cmd_run(args) -> int:
             raise SystemExit(
                 "--impl selects the single-run kernel; ensemble runs "
                 "use --ensemble-impl=xla|pipeline|active|active_fused")
-    elif args.ensemble_impl != "xla":
-        raise SystemExit("--ensemble-impl applies to ensemble runs; "
-                         "add --ensemble=B")
+    elif args.ensemble_impl != "xla" and not args.serve:
+        raise SystemExit("--ensemble-impl applies to ensemble/serve "
+                         "runs; add --ensemble=B or --serve")
     if args.owner_of is not None and args.rectangular is None:
         raise SystemExit(
             "--owner-of reports the 2-D block owner map; add "
@@ -384,6 +502,8 @@ def cmd_run(args) -> int:
                       if args.rectangular is not None else None)
 
     space, model = _build_model(args)
+    if args.serve:
+        return _run_serve(args, space, model)
     if args.ensemble is not None:
         return _run_ensemble(args, space, model)
     executor = _build_executor(args, model)
@@ -624,13 +744,15 @@ def main(argv: Optional[list[str]] = None) -> int:
                      "throughput; the near-ring exact path stays f32)")
     run.add_argument("--substeps", type=int, default=1,
                      help="fused steps per compiled call (serial executor)")
-    run.add_argument("--compile-cache", default=None, metavar="DIR",
+    run.add_argument("--compile-cache", default=None, metavar="DIR|off",
                      help="arm the JAX persistent compilation cache at "
                      "DIR (created if missing): every kernel/runner "
                      "compile on this machine is paid once and reused "
                      "across processes — a restarted run or service "
-                     "skips straight to execution (ROADMAP direction 5, "
-                     "first slice)")
+                     "skips straight to execution (ROADMAP direction "
+                     "5). Ensemble/serve runs arm a per-user default "
+                     "cache even without this flag; pass 'off' to "
+                     "disable that explicitly")
     run.add_argument("--ensemble", type=int, default=None, metavar="B",
                      help="step B independent copies of the scenario as "
                      "ONE batched device program through the ensemble "
@@ -649,6 +771,30 @@ def main(argv: Optional[list[str]] = None) -> int:
                      "per-scenario rates and per-scenario activity), "
                      "or 'active_fused' (the fused Pallas active "
                      "kernel per lane)")
+    run.add_argument("--serve", action="store_true",
+                     help="drive the always-on async serving loop "
+                     "(ISSUE 9): --serve-scenarios copies of the "
+                     "configured scenario arrive open-loop at "
+                     "--arrival-rate/s against a bounded admission "
+                     "queue; reports sustained scenarios/s, p50/p99 "
+                     "queue latency, occupancy and the complete "
+                     "served/shed/expired/failed ledger")
+    run.add_argument("--serve-scenarios", type=int, default=64,
+                     metavar="N",
+                     help="scenarios offered to the serving loop "
+                     "(default 64)")
+    run.add_argument("--arrival-rate", type=float, default=None,
+                     metavar="HZ",
+                     help="open-loop arrival rate in scenarios/s "
+                     "(unset/0 = open throttle: submit as fast as "
+                     "admission allows)")
+    run.add_argument("--deadline-s", type=float, default=None,
+                     help="per-ticket deadline: a scenario still "
+                     "queued past this expires with a complete "
+                     "FailureEvent instead of being served late")
+    run.add_argument("--max-queue", type=int, default=64,
+                     help="admission-queue bound: submissions beyond "
+                     "this shed with ServiceOverloaded (default 64)")
     run.add_argument("--mesh", default=None,
                      help="LxC device mesh for sharded execution "
                      "(e.g. 4x1, 2x4); omit for serial")

@@ -5,33 +5,52 @@ compile cache.
                   the vmapped parametric step, per-scenario conservation,
                   EnsembleExecutor (impl="xla" | "pipeline");
 - ``scheduler`` — scenario queue with bucketed batching (pad to bucket,
-                  max-wait/max-batch flush, runner cache + hit counters);
-- ``service``   — submit/poll facade with throughput counters.
+                  max-wait/max-batch flush, runner cache + hit counters,
+                  thread-safe launch/complete dispatch phases, ticket
+                  deadlines, retry budgets, the health-gated ladder);
+- ``service``   — submit/poll facades: the synchronous
+                  ``EnsembleService`` and the always-on
+                  ``AsyncEnsembleService`` dispatch loop (ISSUE 9:
+                  double-buffered launch/finish, bounded admission with
+                  ``ServiceOverloaded`` shedding, donated inter-window
+                  state), plus the ``run_soak`` open-loop driver.
 
-See docs/DESIGN.md "Ensemble serving" for why the batch axis sits
-OUTSIDE the mesh axes.
+See docs/DESIGN.md "Ensemble serving" / "Always-on serving" for why the
+batch axis sits OUTSIDE the mesh axes and how the loop overlaps host
+assembly with device compute.
 """
 
 from .batch import (
     EnsembleConservationError,
     EnsembleExecutor,
+    EnsembleInFlight,
     EnsembleSpace,
+    complete_ensemble,
+    launch_ensemble,
     run_ensemble,
     structure_key,
 )
 from .scheduler import (DEFAULT_BUCKETS, DispatchTimeout,
-                        EnsembleScheduler, buckets_for)
-from .service import EnsembleService
+                        EnsembleScheduler, TicketExpired, buckets_for)
+from .service import (AsyncEnsembleService, EnsembleService,
+                      ServiceOverloaded, run_soak)
 
 __all__ = [
+    "AsyncEnsembleService",
     "DispatchTimeout",
     "EnsembleConservationError",
     "EnsembleExecutor",
+    "EnsembleInFlight",
     "EnsembleScheduler",
     "EnsembleService",
     "EnsembleSpace",
+    "ServiceOverloaded",
+    "TicketExpired",
     "DEFAULT_BUCKETS",
     "buckets_for",
+    "complete_ensemble",
+    "launch_ensemble",
     "run_ensemble",
+    "run_soak",
     "structure_key",
 ]

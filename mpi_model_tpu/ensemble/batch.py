@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import copy
 import dataclasses
+import threading
 import time as _time
 from typing import Callable, Optional, Sequence
 
@@ -410,6 +411,12 @@ class EnsembleExecutor:
         self.last_impl: Optional[str] = None
         #: per-run report detail (impl="active" stats); None otherwise
         self.last_backend_report: Optional[dict] = None
+        #: guards the runner cache + its build/hit counters: the async
+        #: loop pins all dispatching to one pump thread, but the SYNC
+        #: service dispatches inline on whichever client thread filled
+        #: the bucket — two racing submitters must not double-compile a
+        #: runner or lose counter updates (ISSUE 9 thread-safety work)
+        self._cache_lock = threading.Lock()
         self._cache: dict = {}
         #: runner-build / cache-hit counters (the scheduler's
         #: compile-cache-hit fields read these)
@@ -417,29 +424,46 @@ class EnsembleExecutor:
         self.cache_hits = 0
 
     def runner_for(self, model, espace: EnsembleSpace,
-                   uniform_rates: Optional[dict] = None):
+                   uniform_rates: Optional[dict] = None,
+                   donate: bool = False):
+        """``donate=True`` (xla impl only) builds the runner with
+        ``donate_argnums=0``: the ``[B,H,W]`` state pytree is consumed
+        by each call and its buffers are reused for the output — the
+        copy-free carry between consecutive WINDOWS of the same
+        scenario batch (ISSUE 9; the pjit donation idiom of
+        SNIPPETS.md [1]/[3]). Donated and undonated runners cache under
+        distinct keys (same jaxpr, different aliasing contract)."""
+        if donate and self.impl != "xla":
+            raise ValueError(
+                f"donated dispatch supports impl='xla' only (the "
+                f"'{self.impl}' runner carries stat lanes alongside the "
+                "state, so the carry is not a pure [B,H,W] pytree)")
         key = (espace.batch, espace.shape, self.impl, self.substeps,
                str(self.compute_dtype) if self.compute_dtype is not None
                else None,
-               structure_key(model, espace))
+               structure_key(model, espace), bool(donate))
         if uniform_rates is not None:
             key = key + (tuple(sorted(uniform_rates.items())),)
-        runner = self._cache.get(key)
-        if runner is not None:
-            self.cache_hits += 1
+        # build INSIDE the lock: serializing a miss is the point — two
+        # racing sync-path submitters must get one build, one hit
+        with self._cache_lock:
+            runner = self._cache.get(key)
+            if runner is not None:
+                self.cache_hits += 1
+                return runner
+            self.builds += 1
+            if self.impl == "pipeline":
+                runner = self._build_pipeline(model, espace, uniform_rates)
+            elif self.impl in ("active", "active_fused"):
+                runner = self._build_active(
+                    model, espace, fused=self.impl == "active_fused")
+            else:
+                runner = self._build_xla(model, espace, donate=donate)
+            self._cache[key] = runner
             return runner
-        self.builds += 1
-        if self.impl == "pipeline":
-            runner = self._build_pipeline(model, espace, uniform_rates)
-        elif self.impl in ("active", "active_fused"):
-            runner = self._build_active(model, espace,
-                                        fused=self.impl == "active_fused")
-        else:
-            runner = self._build_xla(model, espace)
-        self._cache[key] = runner
-        return runner
 
-    def _build_xla(self, model, espace: EnsembleSpace):
+    def _build_xla(self, model, espace: EnsembleSpace,
+                   donate: bool = False):
         single = make_scenario_step(model, espace)
         substeps = self.substeps
 
@@ -461,7 +485,9 @@ class EnsembleExecutor:
                 0, r, lambda i, c: b1(c, rates_b, frozens_b), vb)
             return vb
 
-        return jax.jit(run)
+        # donation aliases the output onto the input buffers — the SAME
+        # program (bitwise) minus the inter-window copy of the state
+        return jax.jit(run, donate_argnums=0) if donate else jax.jit(run)
 
     def last_execute_for(self, model, espace: EnsembleSpace):
         """Batched ``Flow.execute``: ONE jitted vmapped program producing
@@ -473,20 +499,21 @@ class EnsembleExecutor:
         count STEP programs only (the serving occupancy metric)."""
         key = ("last_execute", espace.batch, espace.shape,
                structure_key(model, espace))
-        fn = self._cache.get(key)
-        if fn is None:
-            template = list(model.flows)
+        with self._cache_lock:
+            fn = self._cache.get(key)
+            if fn is None:
+                template = list(model.flows)
 
-            def single(values: Values, rates, frozens):
-                flows = _substituted(template, rates, frozens)
-                if not flows:
-                    return jnp.zeros((0,), jnp.float32)
-                return jnp.stack([jnp.sum(f.outflow(values, (0, 0)))
-                                  for f in flows])
+                def single(values: Values, rates, frozens):
+                    flows = _substituted(template, rates, frozens)
+                    if not flows:
+                        return jnp.zeros((0,), jnp.float32)
+                    return jnp.stack([jnp.sum(f.outflow(values, (0, 0)))
+                                      for f in flows])
 
-            fn = jax.jit(jax.vmap(single, in_axes=(0, 0, 0)))
-            self._cache[key] = fn
-        return fn
+                fn = jax.jit(jax.vmap(single, in_axes=(0, 0, 0)))
+                self._cache[key] = fn
+            return fn
 
     def _build_active(self, model, espace: EnsembleSpace,
                       fused: bool = False):
@@ -633,30 +660,72 @@ def _uniform_rates(model, models, rates_np: np.ndarray) -> dict:
     return rates
 
 
-def run_ensemble(model, spaces, *, models=None, executor=None, steps=None,
-                 check_conservation: bool = True, tolerance: float = 1e-3,
-                 rtol: Optional[float] = None, count: Optional[int] = None,
-                 on_violation: str = "raise") -> list:
-    """Step B scenarios in one device program; the engine behind
-    ``Model.execute_many`` and the scheduler.
+@dataclasses.dataclass
+class EnsembleInFlight:
+    """One LAUNCHED-but-not-fetched ensemble dispatch (ISSUE 9): the
+    device program is dispatched (async), nothing is blocked on, and
+    every host-side artifact ``complete_ensemble`` needs travels here.
+    The always-on serving loop launches batch N, assembles/launches
+    batch N+1 on the host thread while N runs on-device, then completes
+    N — ``run_ensemble`` is the degenerate launch-then-complete
+    composition, so the synchronous path and the async path execute the
+    SAME code (bitwise results by construction)."""
 
-    ``models`` (default: ``model`` for every lane) supplies per-scenario
-    numeric parameters; every entry must share ``model``'s structure
-    (``structure_key``). ``count`` limits conservation checks and
-    returned results to the first ``count`` lanes (the scheduler's
-    padding protocol). ``on_violation``: ``"raise"`` raises
-    ``EnsembleConservationError`` on the first bad lane; ``"mark"``
-    returns that lane's error OBJECT in its result slot instead, so the
-    other scenarios' results survive a bad neighbor.
+    executor: "EnsembleExecutor"
+    model: object
+    espace: EnsembleSpace
+    #: the runner's raw output (dict of [B,H,W] values, or the active
+    #: impls' (values, stat-lanes) tuple) — dispatched, NOT blocked on
+    out: object
+    rates_b: object
+    frozens_b: object
+    count: int
+    num_steps: int
+    #: per-channel [B] initial totals (device scalars / host ints)
+    initial_d: dict
+    #: perf_counter at dispatch, for the batch wall time
+    t0: float
+    #: (lane, Fault) poisons captured at LAUNCH (the scheduler's
+    #: ticket→lane window is open then; applied at complete)
+    poisons: list
+    #: windows whose carry was verifiably donated (buffer reused, no
+    #: inter-window copy) — the no-copy assertion's observable
+    donated_windows: int = 0
+    windows: int = 1
+    #: perf_counter when the launch returned (device program enqueued):
+    #: the wall bills launch + fetch, NOT the async overlap gap between
+    #: them (during which this batch ran unobserved while the loop
+    #: assembled its successor)
+    t_launched: float = 0.0
 
-    Returns a list of ``(CellularSpace, Report)`` per real lane (or an
-    ``EnsembleConservationError`` in a violating lane's slot under
-    ``"mark"``). Each Report carries the scenario's own totals and
-    ``last_execute``; ``wall_time_s`` is the BATCH dispatch's wall time
-    (shared by construction — one program stepped every lane).
-    """
-    if on_violation not in ("raise", "mark"):
-        raise ValueError(f"unknown on_violation {on_violation!r}")
+
+def _window_steps(num_steps: int, windows: int) -> list[int]:
+    """Split ``num_steps`` across ``windows`` runner calls (earlier
+    windows take the remainder): same step sequence, so windowed
+    results are bitwise-equal to the single-call dispatch."""
+    windows = max(1, min(int(windows), max(num_steps, 1)))
+    base, rem = divmod(num_steps, windows)
+    return [base + (1 if w < rem else 0) for w in range(windows)]
+
+
+def launch_ensemble(model, spaces, *, models=None, executor=None,
+                    steps=None, count: Optional[int] = None,
+                    windows: int = 1,
+                    donate: bool = False) -> EnsembleInFlight:
+    """Validate, stack, resolve/compile the runner and DISPATCH one
+    ensemble batch without fetching results — the launch half of
+    ``run_ensemble`` (module docstring there). Everything host-side
+    (structure checks, padding-compatible stacking, runner-cache
+    lookup, compile on a miss) happens here, so an async serving loop
+    overlaps this work with the previous batch's device execution.
+
+    ``windows > 1`` advances the batch in that many runner calls
+    instead of one (same step sequence — bitwise identical); with
+    ``donate=True`` (xla impl only) each window's carry is DONATED to
+    the next, eliminating the inter-window copy of the ``[B,H,W]``
+    state; ``EnsembleInFlight.donated_windows`` counts the windows
+    whose input buffers were verifiably consumed (``is_deleted``) —
+    the no-copy assertion the serving tests pin."""
     spaces = list(spaces)
     B = len(spaces)
     if B == 0:
@@ -678,25 +747,99 @@ def run_ensemble(model, spaces, *, models=None, executor=None, steps=None,
         executor = EnsembleExecutor()
     count = B if count is None else int(count)
     num_steps = model.num_steps if steps is None else int(steps)
+    windows = max(1, int(windows))
+    if windows > 1 and executor.impl != "xla":
+        raise ValueError(
+            f"windowed dispatch supports impl='xla' only, got "
+            f"{executor.impl!r} (the stat-lane carry of the active "
+            "impls does not window)")
     rates_np, frozens_np = flow_params(models)
     # the uniform-rate requirement binds REAL lanes only: padding lanes
     # are all-zero VALUES, so the kernel's static shared rate keeps them
     # identically zero regardless of their (zero-rate) parameter lanes
     uniform = (None if executor.impl != "pipeline"
                else _uniform_rates(model, models, rates_np[:count]))
-    runner = executor.runner_for(model, espace, uniform)
+    runner = executor.runner_for(model, espace, uniform, donate=donate)
     # f64 host params: jnp.asarray keeps f64 under x64 (bit-parity with
     # the serial path's python-float rates), f32 otherwise
     rates_b = jnp.asarray(rates_np)
     frozens_b = jnp.asarray(frozens_np)
-    q, r = divmod(num_steps, executor.substeps)
 
+    # initial totals are dispatched BEFORE the (possibly donating)
+    # runner call: the runtime sequences the donated execution after
+    # these reads, so the totals see the pre-step state
     initial_d = batched_totals(espace.values)
+    # chaos seam (resilience.inject): lane poisons are CAPTURED at
+    # launch (the scheduler's ticket→lane push window is open now) and
+    # applied at complete — one firing per dispatch either way
+    st = inject.active()
+    poisons = (list(st.ensemble_poisons(st.bump("ensemble")))
+               if st is not None else [])
     t0 = _time.perf_counter()
-    out = runner(espace.values, rates_b, frozens_b,
-                 jnp.int32(q), jnp.int32(r))
-    out = jax.tree.map(jax.block_until_ready, out)
-    wall = _time.perf_counter() - t0
+    donated = 0
+    # the EFFECTIVE window count (the split clamps to num_steps): what
+    # actually ran is what the flight records — the donation audit
+    # compares donated_windows against THIS, never the requested knob
+    steps_list = _window_steps(num_steps, windows)
+    windows = len(steps_list)
+    if windows == 1:
+        q, r = divmod(num_steps, executor.substeps)
+        prev = espace.values
+        out = runner(prev, rates_b, frozens_b, jnp.int32(q), jnp.int32(r))
+        if donate and all(x.is_deleted() for x in jax.tree.leaves(prev)):
+            donated = 1
+    else:
+        vb = espace.values
+        for w_steps in steps_list:
+            q, r = divmod(w_steps, executor.substeps)
+            prev = vb
+            vb = runner(prev, rates_b, frozens_b,
+                        jnp.int32(q), jnp.int32(r))
+            if donate and all(x.is_deleted()
+                              for x in jax.tree.leaves(prev)):
+                donated += 1
+        out = vb
+    return EnsembleInFlight(
+        executor=executor, model=model, espace=espace, out=out,
+        rates_b=rates_b, frozens_b=frozens_b, count=count,
+        num_steps=num_steps, initial_d=initial_d, t0=t0,
+        t_launched=_time.perf_counter(),
+        poisons=poisons, donated_windows=donated, windows=windows)
+
+
+def complete_ensemble(inflight: EnsembleInFlight, *,
+                      check_conservation: bool = True,
+                      tolerance: float = 1e-3,
+                      rtol: Optional[float] = None,
+                      on_violation: str = "raise") -> list:
+    """Block on a launched dispatch, fetch, and build the per-lane
+    results — the completion half of ``run_ensemble`` (the return
+    contract documented there). The ``fetch_nan`` chaos seam fires
+    here: a poison injected at the fetch boundary, downstream of the
+    device program, which the per-lane conservation machinery must
+    catch exactly like a genuinely diverged lane."""
+    if on_violation not in ("raise", "mark"):
+        raise ValueError(f"unknown on_violation {on_violation!r}")
+    executor = inflight.executor
+    model = inflight.model
+    espace = inflight.espace
+    count = inflight.count
+    num_steps = inflight.num_steps
+    rates_b, frozens_b = inflight.rates_b, inflight.frozens_b
+
+    fetch_t0 = _time.perf_counter()
+    out = jax.tree.map(jax.block_until_ready, inflight.out)
+    # the batch wall bills the HOST-OBSERVED dispatch segments: launch
+    # (assembly + device enqueue) plus fetch (block + transfer). Under
+    # the async loop, the gap between them is the overlap window —
+    # this batch ran on-device while the pump assembled its successor —
+    # and billing it would inflate busy_s/occupancy and let a healthy
+    # dispatch blow its deadline on a slow NEIGHBOR's compile. In the
+    # sync composition fetch starts where launch ended, so this is the
+    # same launch-to-done span as ever. A genuinely hung device program
+    # still shows: the hang sits inside the fetch segment.
+    wall = ((inflight.t_launched - inflight.t0)
+            + (_time.perf_counter() - fetch_t0))
     # the active engine's runner returns ([B] fallback-event,
     # [B] active-tile) stat lanes alongside the values; fold them into
     # backend_report so a batch that dense-fell-back every step is
@@ -713,14 +856,15 @@ def run_ensemble(model, spaces, *, models=None, executor=None, steps=None,
         fb_arr = np.asarray(fb_b)
         at_arr = np.asarray(at_b)
         ff_arr = np.asarray(ff_b)
-    # chaos seam (resilience.inject): an armed lane_nan fault writes
-    # NaN into a scenario lane's OUTPUT here — upstream of the totals,
-    # so the per-lane conservation machinery must catch it exactly the
-    # way it would catch a genuinely diverged lane
+    # launch-captured lane poisons (lane_nan) + the fetch-boundary seam
+    poisons = list(inflight.poisons)
     st = inject.active()
     if st is not None:
-        for lane, fault in st.ensemble_poisons(st.bump("ensemble")):
-            out = inject.poison_lane_values(out, lane, fault)
+        f = st.take("fetch", st.bump("fetch"), kinds=("fetch_nan",))
+        if f is not None:
+            poisons.append((f.lane if f.lane is not None else 0, f))
+    for lane, fault in poisons:
+        out = inject.poison_lane_values(out, lane, fault)
     final_d = batched_totals(out)
     executor.last_impl = executor.impl
     executor.last_backend_report = None
@@ -764,7 +908,8 @@ def run_ensemble(model, spaces, *, models=None, executor=None, steps=None,
         executor.last_execute_for(model, espace)(out, rates_b, frozens_b),
         np.float64)
 
-    initial = {k: np.asarray(v, np.float64) for k, v in initial_d.items()}
+    initial = {k: np.asarray(v, np.float64)
+               for k, v in inflight.initial_d.items()}
     final = {k: np.asarray(v, np.float64) for k, v in final_d.items()}
     bad: list[int] = []
     thresholds = None
@@ -807,3 +952,40 @@ def run_ensemble(model, spaces, *, models=None, executor=None, steps=None,
             }),
         )))
     return results
+
+
+def run_ensemble(model, spaces, *, models=None, executor=None, steps=None,
+                 check_conservation: bool = True, tolerance: float = 1e-3,
+                 rtol: Optional[float] = None, count: Optional[int] = None,
+                 on_violation: str = "raise") -> list:
+    """Step B scenarios in one device program; the engine behind
+    ``Model.execute_many`` and the scheduler.
+
+    ``models`` (default: ``model`` for every lane) supplies per-scenario
+    numeric parameters; every entry must share ``model``'s structure
+    (``structure_key``). ``count`` limits conservation checks and
+    returned results to the first ``count`` lanes (the scheduler's
+    padding protocol). ``on_violation``: ``"raise"`` raises
+    ``EnsembleConservationError`` on the first bad lane; ``"mark"``
+    returns that lane's error OBJECT in its result slot instead, so the
+    other scenarios' results survive a bad neighbor.
+
+    Returns a list of ``(CellularSpace, Report)`` per real lane (or an
+    ``EnsembleConservationError`` in a violating lane's slot under
+    ``"mark"``). Each Report carries the scenario's own totals and
+    ``last_execute``; ``wall_time_s`` is the BATCH dispatch's wall time
+    (shared by construction — one program stepped every lane).
+
+    This is the synchronous composition of ``launch_ensemble`` +
+    ``complete_ensemble`` (ISSUE 9): the always-on serving loop drives
+    the two halves separately to overlap host assembly with device
+    compute, and both paths therefore execute the same code — async
+    results are bitwise-equal to this function's by construction.
+    """
+    if on_violation not in ("raise", "mark"):
+        raise ValueError(f"unknown on_violation {on_violation!r}")
+    inflight = launch_ensemble(model, spaces, models=models,
+                               executor=executor, steps=steps, count=count)
+    return complete_ensemble(inflight, check_conservation=check_conservation,
+                             tolerance=tolerance, rtol=rtol,
+                             on_violation=on_violation)

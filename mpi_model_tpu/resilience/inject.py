@@ -30,6 +30,23 @@ site           where the seam lives / what the fault does
                whole dispatch; ``kind="hang"`` adds seconds to the
                dispatch's injectable-clock duration so the deadline
                policy sees a hang
+``pump``       the async serving loop (ISSUE 9) — ``kind="thread_exc"``
+               raises :class:`InjectedFault` at the top of one pump
+               iteration: the loop's supervisor must count it and keep
+               serving (a dead dispatch thread is a dead service)
+``assemble``   batch assembly/compile on the dispatch thread —
+               ``kind="slow_compile"`` adds ``seconds`` to that
+               dispatch's injectable-clock duration (a hung compile),
+               driving the dispatch-deadline and health-gate paths
+``fetch``      the non-blocking result fetch — ``kind="fetch_nan"``
+               poisons scenario lane ``lane`` (default 0) of the fetched
+               output, downstream of the device program: the per-lane
+               conservation machinery must catch it like any diverged
+               lane
+``admission``  the bounded admission queue — ``kind="queue_full"`` makes
+               one submission behave as if the queue were full
+               (``ServiceOverloaded`` shed), exercising the overflow
+               path without needing real backlog
 =============  ==============================================================
 
 Zero overhead when disarmed: every seam starts with one module-global
@@ -81,6 +98,11 @@ SITE_OF = {
     "lane_nan": "ensemble",
     "batch_exc": "dispatch",
     "hang": "dispatch",
+    # ISSUE 9: the always-on async serving seams
+    "thread_exc": "pump",
+    "slow_compile": "assemble",
+    "fetch_nan": "fetch",
+    "queue_full": "admission",
 }
 
 
@@ -102,7 +124,8 @@ class Fault:
     channel: Optional[str] = None
     #: cell to poison (None → (0, 0))
     cell: Optional[tuple] = None
-    #: scenario lane to poison (direct run_ensemble use)
+    #: scenario lane to poison (direct run_ensemble use; also the
+    #: "fetch_nan" target lane, default 0)
     lane: Optional[int] = None
     #: scheduler ticket whose lane to poison (the scheduler maps it)
     ticket: Optional[int] = None
@@ -112,7 +135,8 @@ class Fault:
     nbytes: int = 64
     #: "truncate" (tear the file AT offset) or "corrupt" (flip bytes)
     tear: str = "corrupt"
-    #: injected hang duration ("hang"), in injectable-clock seconds
+    #: injected hang duration ("hang"/"slow_compile"), in
+    #: injectable-clock seconds
     seconds: float = 0.0
     #: poison / perturbation value (None → NaN for poisons, 1.0 for halo)
     value: Optional[float] = None

@@ -78,7 +78,9 @@ class FailureEvent:
     #: step the failed chunk would have reached
     step: int
     #: "exception" (executor raised) | "nonfinite" | "conservation"
-    #: | "timeout" (a dispatch overran its deadline)
+    #: | "timeout" (a dispatch overran its deadline) | "expired" (a
+    #: queued ticket's per-ticket deadline passed before dispatch —
+    #: the ISSUE 9 serving path; never a silent drop)
     kind: str
     detail: str
     #: step rolled back to (== step of the last good checkpoint)

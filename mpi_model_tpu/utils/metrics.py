@@ -11,8 +11,10 @@ alone does not block through the tunnel).
 
 from __future__ import annotations
 
+import collections
+import threading
 import time
-from typing import Callable
+from typing import Callable, Optional
 
 Values = dict
 
@@ -193,17 +195,37 @@ def median_spread(samples: list[float]) -> dict:
             "spread_lo": min(samples), "spread_hi": max(samples)}
 
 
+#: latency samples kept for the percentile fields — bounded so a
+#: long-lived service cannot grow the reservoir forever (at 64Ki samples
+#: the p50/p99 of the RECENT traffic is what the snapshot reports, which
+#: is what an operator watching a live service wants anyway)
+LATENCY_RESERVOIR = 65536
+
+
 class ThroughputCounter:
     """Monotonic serving counters for the ensemble engine (scheduler /
     service): scenarios served, dispatches, dispatched lanes (incl.
     bucket padding), busy wall seconds, runner-cache hits.
 
+    THREAD-SAFE (ISSUE 9 satellite): the async serving loop mutates
+    these counters from the dispatch thread while clients read
+    ``snapshot()`` (and bump shed counters) from their own threads, so
+    every mutation goes through a method that takes the single internal
+    lock, and ``snapshot()`` is taken under the same lock — the returned
+    dict is one consistent cut, never a torn read (e.g. ``scenarios``
+    from before a dispatch with ``busy_s`` from after it). Counters are
+    never written by attribute assignment from outside; use
+    ``record_dispatch`` / ``record_latency`` / ``bump``.
+
     ``snapshot()`` derives the serving metrics the bench/CLI publish:
     ``scenarios_per_s`` (scenarios / busy seconds — DISPATCH wall only,
     so queueing latency from a max-wait policy is not billed as
     compute), ``batch_occupancy`` (real lanes / dispatched lanes — how
-    much of each padded bucket did real work) and
-    ``compile_cache_hit_rate`` (dispatches that reused a built runner).
+    much of each padded bucket did real work),
+    ``compile_cache_hit_rate`` (dispatches that reused a built runner)
+    and the queue-latency percentiles ``latency_p50_s``/``latency_p99_s``
+    (submit-to-served by the scheduler's clock, over the most recent
+    ``LATENCY_RESERVOIR`` served scenarios).
 
     The self-healing counters (ISSUE 5) make recovery observable, never
     silent: ``solo_retries`` (failed scenarios re-dispatched alone),
@@ -211,46 +233,118 @@ class ThroughputCounter:
     fault was the batch's, not theirs), ``quarantined`` (scenarios whose
     solo retry failed too — deterministic scenario faults, isolated with
     their ``FailureEvent``) and ``impl_faults`` (whole-dispatch failures
-    feeding the degradation ladder).
+    feeding the degradation ladder). ISSUE 9 adds the overload/deadline
+    ledger: ``shed`` (submissions refused at admission —
+    ``ServiceOverloaded``) and ``expired`` (tickets whose deadline
+    passed before dispatch — resolved as ``TicketExpired`` with a
+    complete ``FailureEvent``, never silently dropped).
     """
 
+    #: the integer counters bump() accepts — a typo'd name must fail
+    #: loudly, not silently count into a new attribute nothing reads
+    COUNTERS = ("dispatches", "scenarios", "lanes", "cache_hits",
+                "solo_retries", "recovered_failures", "quarantined",
+                "impl_faults", "shed", "expired", "loop_faults")
+
     def __init__(self):
+        self._lock = threading.Lock()
         self.dispatches = 0
         self.scenarios = 0
         self.lanes = 0
         self.busy_s = 0.0
+        #: launch-to-complete span per dispatch, summed — the time a
+        #: dispatch was OUTSTANDING (device had work in flight). Under
+        #: the async loop this exceeds busy_s (which bills only the
+        #: host-observed launch+fetch segments): inflight_s/wall is the
+        #: serving occupancy metric; busy_s feeds scenarios_per_s.
+        #: Synchronously the two coincide.
+        self.inflight_s = 0.0
         self.cache_hits = 0
         self.solo_retries = 0
         self.recovered_failures = 0
         self.quarantined = 0
         self.impl_faults = 0
+        #: submissions refused at admission (bounded queue / health gate)
+        self.shed = 0
+        #: tickets whose per-ticket deadline passed before dispatch
+        self.expired = 0
+        #: dispatch-loop iterations that raised and were supervised
+        #: (the loop stays alive; the fault is counted, never silent)
+        self.loop_faults = 0
+        self._latencies: collections.deque = collections.deque(
+            maxlen=LATENCY_RESERVOIR)
 
     def record_dispatch(self, scenarios: int, bucket: int, wall_s: float,
-                        cache_hit: bool) -> None:
-        self.dispatches += 1
-        self.scenarios += int(scenarios)
-        self.lanes += int(bucket)
-        self.busy_s += float(wall_s)
-        if cache_hit:
-            self.cache_hits += 1
+                        cache_hit: bool,
+                        inflight_s: Optional[float] = None) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.scenarios += int(scenarios)
+            self.lanes += int(bucket)
+            self.busy_s += float(wall_s)
+            self.inflight_s += float(wall_s if inflight_s is None
+                                     else inflight_s)
+            if cache_hit:
+                self.cache_hits += 1
+
+    def bump(self, name: str, n: int = 1) -> None:
+        """Increment one named counter under the lock — the ONLY
+        sanctioned way to mutate a counter from outside (attribute
+        ``+=`` from another thread is a lost-update race)."""
+        if name not in self.COUNTERS:
+            raise ValueError(
+                f"unknown counter {name!r} (expected one of "
+                f"{self.COUNTERS})")
+        with self._lock:
+            setattr(self, name, getattr(self, name) + int(n))
+
+    def busy_per_scenario(self) -> Optional[float]:
+        """busy seconds per served scenario (None before any serve) —
+        a two-read O(1) accessor for hot paths (admission's retry-after
+        estimate) that must not pay ``snapshot()``'s reservoir sort."""
+        with self._lock:
+            return self.busy_s / self.scenarios if self.scenarios else None
+
+    def record_latency(self, seconds: float) -> None:
+        """One served scenario's submit-to-served latency (scheduler
+        clock), feeding the p50/p99 snapshot fields."""
+        with self._lock:
+            self._latencies.append(float(seconds))
+
+    @staticmethod
+    def _percentile(sorted_samples: list, q: float) -> float:
+        i = min(int(round(q * (len(sorted_samples) - 1))),
+                len(sorted_samples) - 1)
+        return sorted_samples[i]
 
     def snapshot(self) -> dict:
-        return {
-            "dispatches": self.dispatches,
-            "scenarios": self.scenarios,
-            "scenarios_per_s": (self.scenarios / self.busy_s
-                                if self.busy_s > 0 else None),
-            "batch_occupancy": (self.scenarios / self.lanes
-                                if self.lanes else None),
-            "compile_cache_hits": self.cache_hits,
-            "compile_cache_hit_rate": (self.cache_hits / self.dispatches
-                                       if self.dispatches else None),
-            "busy_s": self.busy_s,
-            "solo_retries": self.solo_retries,
-            "recovered_failures": self.recovered_failures,
-            "quarantined": self.quarantined,
-            "impl_faults": self.impl_faults,
-        }
+        with self._lock:
+            lat = sorted(self._latencies)
+            return {
+                "dispatches": self.dispatches,
+                "scenarios": self.scenarios,
+                "scenarios_per_s": (self.scenarios / self.busy_s
+                                    if self.busy_s > 0 else None),
+                "batch_occupancy": (self.scenarios / self.lanes
+                                    if self.lanes else None),
+                "compile_cache_hits": self.cache_hits,
+                "compile_cache_hit_rate": (self.cache_hits / self.dispatches
+                                           if self.dispatches else None),
+                "busy_s": self.busy_s,
+                "inflight_s": self.inflight_s,
+                "solo_retries": self.solo_retries,
+                "recovered_failures": self.recovered_failures,
+                "quarantined": self.quarantined,
+                "impl_faults": self.impl_faults,
+                "shed": self.shed,
+                "expired": self.expired,
+                "loop_faults": self.loop_faults,
+                "latency_n": len(lat),
+                "latency_p50_s": (self._percentile(lat, 0.50)
+                                  if lat else None),
+                "latency_p99_s": (self._percentile(lat, 0.99)
+                                  if lat else None),
+            }
 
 
 def marginal_runner_time(make_output: Callable[[int], object],

@@ -77,12 +77,16 @@ class Tracer:
             yield
             return
         depth = getattr(self._local, "depth", 0)
+        # analysis: ignore[unguarded-shared-mutation] — threading.local
+        # storage: each thread mutates only its own depth slot
         self._local.depth = depth + 1
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
+            # analysis: ignore[unguarded-shared-mutation] — threading.local
+            # storage: each thread mutates only its own depth slot
             self._local.depth = depth
             s = Span(name=name, start_s=t0, duration_s=dt,
                      thread=threading.get_ident(), depth=depth,

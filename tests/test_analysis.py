@@ -532,3 +532,110 @@ def test_repo_is_clean_under_strict_analysis():
         if f.suppressed:
             assert f.suppress_reason, (
                 f"suppression without a reason at {f.path}:{f.line}")
+
+
+# -- unguarded-shared-mutation (ISSUE 9: threaded-serving guard) --------------
+
+_THREADED_HDR = "import threading\n"
+_LOCKED_CLS = ("class Sched:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.RLock()\n"
+               "        self.count = 0\n")
+
+
+def test_unguarded_shared_mutation_positive():
+    # attribute write, augmented write, subscript write and delete —
+    # all outside the lock in a lock-owning class of a threaded module
+    src = (_THREADED_HDR + _LOCKED_CLS +
+           "    def bump(self):\n"
+           "        self.count += 1\n"
+           "        self.last = 3\n"
+           "        self.table['k'] = 1\n"
+           "        del self.table['k']\n")
+    assert rules_of(lint_source(src, PKG)) == (
+        ["unguarded-shared-mutation"] * 4)
+    # nested attribute chains root at self too (self.counter.solo += 1)
+    src2 = (_THREADED_HDR + _LOCKED_CLS +
+            "    def note(self):\n"
+            "        self.counter.solo += 1\n")
+    assert rules_of(lint_source(src2, PKG)) == ["unguarded-shared-mutation"]
+
+
+def test_unguarded_shared_mutation_guarded_and_escapes():
+    # inside `with self._lock:` — clean; __init__ and *_locked methods
+    # are exempt by convention; a Condition named *_cv guards too
+    src = (_THREADED_HDR + _LOCKED_CLS +
+           "    def bump(self):\n"
+           "        with self._lock:\n"
+           "            self.count += 1\n"
+           "    def _pop_locked(self):\n"
+           "        self.count -= 1\n")
+    assert rules_of(lint_source(src, PKG)) == []
+    src2 = (_THREADED_HDR +
+            "class Loop:\n"
+            "    def __init__(self):\n"
+            "        self._lock_cv = threading.Condition()\n"
+            "        self._stop = False\n"
+            "    def stop(self):\n"
+            "        with self._lock_cv:\n"
+            "            self._stop = True\n")
+    assert rules_of(lint_source(src2, PKG)) == []
+
+
+def test_unguarded_shared_mutation_scope_limits():
+    # a class with NO lock in a threaded module: out of scope (nothing
+    # asserts it is shared across threads)
+    src = (_THREADED_HDR +
+           "class Plain:\n"
+           "    def __init__(self):\n"
+           "        self.count = 0\n"
+           "    def bump(self):\n"
+           "        self.count += 1\n")
+    assert rules_of(lint_source(src, PKG)) == []
+    # a lock-owning class in a module that never imports threading:
+    # out of scope (single-threaded by construction)
+    src2 = (_LOCKED_CLS +
+            "    def bump(self):\n"
+            "        self.count += 1\n")
+    assert rules_of(lint_source(src2, PKG)) == []
+    # plain locals and non-self roots never flag
+    src3 = (_THREADED_HDR + _LOCKED_CLS +
+            "    def f(self, other):\n"
+            "        n = 1\n"
+            "        other.count += 1\n")
+    assert rules_of(lint_source(src3, PKG)) == []
+    # lock-ISH substrings are not locks: a class binding only an
+    # injectable `self._clock` (or block_size/seconds) is out of
+    # scope, and `with self._clock:` is NOT a guard
+    src4 = (_THREADED_HDR +
+            "class Sched:\n"
+            "    def __init__(self, clock):\n"
+            "        self._clock = clock\n"
+            "        self.block_size = 8\n"
+            "        self.seconds = 0.0\n"
+            "    def tick(self):\n"
+            "        self.seconds += 1.0\n")
+    assert rules_of(lint_source(src4, PKG)) == []
+    src5 = (_THREADED_HDR +
+            "class Sched:\n"
+            "    def __init__(self, clock):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._clock = clock\n"
+            "        self.count = 0\n"
+            "    def bump(self):\n"
+            "        with self._clock:\n"
+            "            self.count += 1\n")
+    out5 = lint_source(src5, PKG)
+    assert rules_of(out5) == ["unguarded-shared-mutation"]
+    assert "self._lock" in out5[0].message  # the REAL lock is named
+
+
+def test_unguarded_shared_mutation_pragma_escape():
+    src = (_THREADED_HDR + _LOCKED_CLS +
+           "    def bump(self):\n"
+           "        # analysis: ignore[unguarded-shared-mutation] — "
+           "thread-local slot, never shared\n"
+           "        self.count += 1\n")
+    out = lint_source(src, PKG)
+    assert rules_of(out) == []
+    assert any(f.suppressed for f in out)

@@ -745,6 +745,146 @@ def test_hung_dispatch_without_retry_raises_timeout():
                 sch.poll(t)
 
 
+# -- always-on async serving chaos (ISSUE 9): the matrix with the loop armed --
+
+def _async_svc(**kw):
+    from mpi_model_tpu.ensemble import AsyncEnsembleService
+
+    kw.setdefault("steps", 4)
+    kw.setdefault("start", False)
+    return AsyncEnsembleService(make_model(4.0), **kw)
+
+
+def test_async_thread_exc_loop_survives_and_serves():
+    """An injected dispatch-thread exception: the pump loop's
+    supervisor counts it and keeps serving — every ticket resolves."""
+    from mpi_model_tpu.ensemble import AsyncEnsembleService
+
+    plan = FaultPlan((Fault("thread_exc", at=0),))
+    with inject.armed(plan) as st:
+        with AsyncEnsembleService(make_model(4.0), steps=4) as svc:
+            tickets = [svc.submit(_scen_space(i)) for i in range(3)]
+            outs = [svc.result(t, timeout=120) for t in tickets]
+    assert [f["kind"] for f in st.fired] == ["thread_exc"]
+    assert len(outs) == 3
+    stats = svc.stats()
+    assert stats["loop_faults"] == 1 and stats["pending"] == 0
+    assert svc.loop_errors and "InjectedFault" in svc.loop_errors[0]
+    # and the served states are still bitwise-correct
+    for i, (sp, _) in enumerate(outs):
+        want, _ = make_model(4.0).execute(_scen_space(i),
+                                          SerialExecutor(), steps=4)
+        np.testing.assert_array_equal(np.asarray(sp.values["value"]),
+                                      np.asarray(want.values["value"]))
+
+
+def test_async_slow_compile_trips_dispatch_deadline_and_recovers():
+    """A hung compile (slow_compile seam) pushes the dispatch past its
+    deadline → DispatchTimeout → solo retries recover every lane."""
+    clock = {"t": 0.0}
+    svc = _async_svc(retry="solo", max_batch=2, dispatch_deadline_s=1.0,
+                     clock=lambda: clock["t"])
+    plan = FaultPlan((Fault("slow_compile", at=0, seconds=5.0),))
+    with inject.armed(plan) as st:
+        a = svc.submit(_scen_space(0))
+        b = svc.submit(_scen_space(1))
+        while svc.pump_once(force=True):
+            pass
+        ra, rb = svc.poll(a), svc.poll(b)
+    assert [f["kind"] for f in st.fired] == ["slow_compile"]
+    assert ra is not None and rb is not None
+    stats = svc.stats()
+    assert stats["recovered_failures"] == 2 and stats["impl_faults"] == 1
+    assert any("DispatchTimeout" in d.get("error", "")
+               for d in svc.scheduler.dispatch_log)
+    svc.stop()
+
+
+def test_async_fetch_nan_detected_and_solo_recovered():
+    """A poison at the non-blocking fetch boundary: per-lane
+    conservation flags it, the solo retry (fault consumed) recovers the
+    scenario bitwise."""
+    svc = _async_svc(retry="solo", max_batch=2)
+    plan = FaultPlan((Fault("fetch_nan", at=0, lane=0, once=True),))
+    with inject.armed(plan) as st:
+        a = svc.submit(_scen_space(0))
+        b = svc.submit(_scen_space(1))
+        while svc.pump_once(force=True):
+            pass
+        ra, rb = svc.poll(a), svc.poll(b)
+    assert [f["kind"] for f in st.fired] == ["fetch_nan"]
+    assert ra is not None and rb is not None
+    want, _ = make_model(4.0).execute(_scen_space(0), SerialExecutor(),
+                                      steps=4)
+    np.testing.assert_array_equal(np.asarray(ra[0].values["value"]),
+                                  np.asarray(want.values["value"]))
+    stats = svc.stats()
+    assert stats["recovered_failures"] == 1 and stats["solo_retries"] == 1
+    svc.stop()
+
+
+def test_async_queue_full_fault_sheds_at_admission():
+    from mpi_model_tpu.ensemble import ServiceOverloaded
+
+    svc = _async_svc()
+    plan = FaultPlan((Fault("queue_full", at=0),))
+    with inject.armed(plan) as st:
+        with pytest.raises(ServiceOverloaded, match="injected"):
+            svc.submit(_scen_space(0))
+        t = svc.submit(_scen_space(1))  # fault consumed: admitted
+    assert [f["kind"] for f in st.fired] == ["queue_full"]
+    assert svc.stats()["shed"] == 1
+    svc.stop()
+    assert svc.poll(t) is not None
+
+
+def test_async_matrix_multi_fault_bitwise_with_complete_ledger():
+    """The PR 5 chaos matrix armed against the ASYNC loop: transient
+    lane poison + whole-batch fault + hang in one plan; every scenario
+    recovers bitwise and the ledger reconciles with zero silent
+    drops."""
+    clock = {"t": 0.0}
+    svc = _async_svc(retry="solo", max_batch=4, dispatch_deadline_s=1e9,
+                     clock=lambda: clock["t"])
+    # dispatch indices: 0 = wave-1 batch (lane 1 poisoned), 1 = its
+    # recovery solo, 2 = wave-2 batch (batch fault), 3/4 = wave-2 solos
+    # (the hang fires under a generous deadline — seam exercised, no
+    # timeout)
+    plan = FaultPlan((
+        Fault("lane_nan", ticket=1, once=True),
+        Fault("batch_exc", at=2),
+        Fault("hang", at=3, seconds=0.5),
+    ))
+    with inject.armed(plan) as st:
+        tickets = [svc.submit(_scen_space(i)) for i in range(4)]
+        while svc.pump_once(force=True):
+            pass
+        outs = [svc.poll(t) for t in tickets]
+        # second wave rides the SAME service through the batch fault
+        wave2 = [svc.submit(_scen_space(i), steps=3) for i in range(2)]
+        while svc.pump_once(force=True):
+            pass
+        outs2 = [svc.poll(t) for t in wave2]
+    fired = [f["kind"] for f in st.fired]
+    assert "lane_nan" in fired and "batch_exc" in fired
+    assert all(o is not None for o in outs + outs2)
+    for i, (sp, _) in enumerate(outs):
+        want, _ = make_model(4.0).execute(_scen_space(i),
+                                          SerialExecutor(), steps=4)
+        np.testing.assert_array_equal(np.asarray(sp.values["value"]),
+                                      np.asarray(want.values["value"]))
+    stats = svc.stats()
+    # ledger: all 6 submissions served (the recovered lane bills its
+    # solo re-run too — 4 + 1 solo + 2: the PR 5 billing semantics);
+    # the transient lane fault and the batch fault recovered through
+    # solos; nothing quarantined/shed
+    assert stats["scenarios"] == 7 and stats["pending"] == 0
+    assert stats["recovered_failures"] >= 1
+    assert stats["quarantined"] == 0 and stats["shed"] == 0
+    assert stats["expired"] == 0
+    svc.stop()
+
+
 # -- the CLI chaos surface ----------------------------------------------------
 
 def test_cli_chaos_run_recovers(capsys):
