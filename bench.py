@@ -515,7 +515,8 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
                   arrival_rate_hz: Optional[float] = None,
                   deadline_s: Optional[float] = None,
                   max_queue: int = 256, windows: int = 2,
-                  chaos: bool = True, verbose: bool = False) -> dict:
+                  chaos: bool = True, services: int = 1,
+                  verbose: bool = False) -> dict:
     """Always-on serving soak (ISSUE 9): an open-loop arrival process
     drives ``n_scenarios`` scenarios through the async dispatch loop
     (``AsyncEnsembleService`` — double-buffered launch/finish, donated
@@ -532,15 +533,31 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
     bitwise at the timed geometry. The synchronous baseline then drives
     the identical arrival schedule inline, so the occupancy comparison
     is apples-to-apples. ``arrival_rate_hz=None`` calibrates the
-    offered load to ~90% of the sync path's measured service rate."""
+    offered load to ~90% of the sync path's measured service rate.
+
+    ``services > 1`` is the FLEET mode (ISSUE 10 / ladder config 10):
+    the soak drives a journaled ``FleetSupervisor`` instead of one
+    async service, with a ``member_kill`` added to the chaos plan — one
+    member's pump thread dies mid-soak, the supervisor fences and
+    restarts it, and the ledger must still reconcile across members
+    (``member_faults``/``readmitted`` report what the supervision did).
+    A separate kill-restart leg then proves the crash-recovery story:
+    a journaled fleet is hard-abandoned mid-run (a simulated process
+    kill), ``FleetSupervisor.recover`` replays the journal, and the
+    replay audit must show every submitted ticket resolved exactly
+    once (``recovery_ok``)."""
     import numpy as np
     import jax.numpy as jnp
 
     from mpi_model_tpu import CellularSpace, Diffusion, Model
     from mpi_model_tpu.ensemble import (AsyncEnsembleService,
-                                        EnsembleService, buckets_for,
-                                        run_soak)
+                                        EnsembleService, FleetSupervisor,
+                                        buckets_for, run_soak)
+    from mpi_model_tpu.ensemble.journal import journal_path, replay
     from mpi_model_tpu.resilience.inject import Fault, FaultPlan, armed
+
+    if services < 1:
+        raise ValueError(f"services={services} must be >= 1")
 
     enable_compile_cache()
     dtype = jnp.dtype(dtype_name)
@@ -598,7 +615,7 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
     # -- the async soak, chaos armed: transient + loop-level faults
     # spread through the run; every one must resolve to a counted
     # outcome (recovered / quarantined / shed / expired)
-    plan = FaultPlan((
+    faults = [
         Fault("lane_nan", ticket=max(1, n_scenarios // 3), once=True),
         Fault("batch_exc", at=max(2, n_scenarios // (2 * B))),
         Fault("thread_exc", at=3),
@@ -606,10 +623,25 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
         Fault("fetch_nan", at=max(3, n_scenarios // (2 * B)) + 4,
               lane=0, once=True),
         Fault("queue_full", at=max(4, n_scenarios // 2)),
-    ), seed=23) if chaos else FaultPlan(())
-    async_svc = AsyncEnsembleService(
-        template, windows=windows, max_queue=max_queue,
-        deadline_s=deadline_s, **kwargs)
+    ]
+    if services > 1:
+        # fleet mode: one member's pump thread dies MID-soak — the
+        # `at` threshold holds the (channel-unpinned) kill back until
+        # the fleet has pumped enough to be under real load, so the
+        # fencing path runs with tickets actually at stake; the
+        # supervisor must fence + restart it with the stream live
+        faults.append(Fault("member_kill",
+                            at=max(10, n_scenarios // 2)))
+    plan = FaultPlan(tuple(faults), seed=23) if chaos else FaultPlan(())
+    if services > 1:
+        async_svc = FleetSupervisor(
+            template, services=services, windows=windows,
+            max_queue=max_queue, deadline_s=deadline_s,
+            tick_interval_s=0.01, **kwargs)
+    else:
+        async_svc = AsyncEnsembleService(
+            template, windows=windows, max_queue=max_queue,
+            deadline_s=deadline_s, **kwargs)
     with armed(plan) as arm_state, async_svc:
         async_rep = run_soak(async_svc, scenarios, arrival_rate_hz=rate)
     fired = [f["kind"] for f in arm_state.fired]
@@ -621,12 +653,74 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
             f"shed {async_rep['shed']} != offered {async_rep['offered']}")
     # donation honesty from the (bounded) dispatch log: every windowed
     # dispatch still in the log must have carried its state copy-free
-    logged = [d for d in async_svc.scheduler.dispatch_log
-              if "windows" in d]
+    raw_log = (async_svc.dispatch_logs() if services > 1
+               else list(async_svc.scheduler.dispatch_log))
+    logged = [d for d in raw_log if "windows" in d]
     donation_ok = bool(logged) and all(
         d["donated_windows"] == d["windows"] for d in logged)
     occ_ratio = (async_rep["occupancy"] / sync_rep["occupancy"]
                  if sync_rep["occupancy"] else None)
+
+    # -- fleet-only: the kill-restart recovery leg (ISSUE 10) — a
+    # journaled fleet is hard-abandoned mid-run (simulated process
+    # kill), recover() replays the journal, and the replay audit must
+    # show every submitted ticket resolved exactly once
+    fleet_fields: dict = {}
+    if services > 1:
+        import tempfile
+        import time as _t
+
+        fleet_fields = {
+            "services": services,
+            "member_faults": async_rep["member_faults"],
+            "readmitted": async_rep["readmitted"],
+        }
+        rdir = tempfile.mkdtemp(prefix="fleet-journal-")
+        k = min(4 * B, 32)
+        rf = FleetSupervisor(template, services=services,
+                             max_queue=max_queue, journal_dir=rdir,
+                             tick_interval_s=0.01, **kwargs)
+        rts = [rf.submit(pool_spaces[i % B], model=pool_models[i % B],
+                         steps=steps) for i in range(k)]
+        stop_by = _t.monotonic() + 120.0
+        while (_t.monotonic() < stop_by
+               and rf.counter.snapshot()["latency_n"] < k // 2):
+            _t.sleep(0.005)  # let roughly half get harvested, then kill
+        rf.abandon()
+        r2 = FleetSupervisor.recover(rdir, template, services=services,
+                                     max_queue=max_queue,
+                                     tick_interval_s=0.01, **kwargs)
+        rerun = r2.stats()["readmitted"]
+        recovered_served = 0
+        for t in rts:
+            try:
+                r2.result(t, timeout=300)
+                recovered_served += 1
+            # analysis: ignore[broad-except] — per-ticket honesty: a
+            # quarantined/expired recovery outcome is a counted ledger
+            # line, not a bench abort
+            except Exception:
+                pass
+        r2.stop()
+        audit = replay(journal_path(rdir))
+        recovery_ok = (not audit.unresolved()
+                       and not audit.duplicate_terminals
+                       and len(audit.submits) == k)
+        if not recovery_ok:
+            raise AssertionError(
+                f"kill-restart recovery audit failed: unresolved="
+                f"{audit.unresolved()} duplicates="
+                f"{audit.duplicate_terminals} submits="
+                f"{len(audit.submits)}/{k}")
+        fleet_fields.update({
+            "recovery_tickets": k,
+            "recovery_served": recovered_served,
+            "recovery_readmitted": rerun,
+            "recovery_ok": recovery_ok,
+        })
+        if verbose:
+            print(f"  kill-restart: {k} tickets, {rerun} re-admitted "
+                  f"after the kill, audit complete", file=sys.stderr)
     if verbose:
         print(f"  soak: {async_rep['sustained_scenarios_per_s']:.2f} "
               f"scen/s sustained (sync "
@@ -664,6 +758,7 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
         "degraded_from": async_rep["degraded_from"],
         "chaos_fired": fired,
         "donation_ok": donation_ok,
+        **fleet_fields,
     }
 
 
@@ -1283,10 +1378,18 @@ if __name__ == "__main__":
             result = bench_checkpoint(verbose="-v" in sys.argv)
         elif "--serve" in sys.argv:
             # the always-on serving soak (ISSUE 9): open-loop arrivals
-            # with chaos armed; also persists the row as the round's
-            # BENCH_SERVE artifact
-            result = bench_service(verbose="-v" in sys.argv)
-            with open("BENCH_SERVE_r01.json", "w") as fh:
+            # with chaos armed; --serve-services=N (ISSUE 10) shards
+            # the stream over an N-member fleet with a mid-soak member
+            # kill + a kill-restart recovery leg; also persists the row
+            # as the round's BENCH_SERVE artifact
+            n_services = next(
+                (int(a.split("=", 1)[1]) for a in sys.argv
+                 if a.startswith("--serve-services=")), 1)
+            result = bench_service(services=n_services,
+                                   verbose="-v" in sys.argv)
+            out_name = ("BENCH_SERVE_r01.json" if n_services == 1
+                        else "BENCH_FLEET_r01.json")
+            with open(out_name, "w") as fh:
                 json.dump(result, fh, indent=2)
                 fh.write("\n")
         else:

@@ -825,8 +825,31 @@ def config9(quick: bool = False) -> dict:
             **row}
 
 
+def config10(quick: bool = False) -> dict:
+    """Fleet serving soak (ISSUE 10): one open-loop arrival stream
+    sharded over a 3-member ``FleetSupervisor`` with chaos armed —
+    including a mid-soak ``member_kill`` (one member's pump thread dies
+    and is fenced + restarted with the stream live) — plus the
+    kill-restart recovery leg: a journaled fleet hard-abandoned mid-run
+    and recovered, with the replay audit proving every submitted ticket
+    resolved exactly once. The row aborts on an incomplete ledger or a
+    failed recovery audit; ``member_faults``/``readmitted``/
+    ``recovery_ok`` report what the supervision actually did."""
+    import bench as bench_mod
+
+    g = 64 if quick else 128
+    row = bench_mod.bench_service(
+        grid=g, B=4 if quick else 8, steps=4 if quick else 8,
+        n_scenarios=40 if quick else 400,
+        windows=2, services=3)
+    return {"config": 10, "flow": "diffusion (per-scenario rates)",
+            "strategy": "fleet-sharded serving soak (member kill + "
+                        "crash-restart recovery)",
+            **row}
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8, 9: config9}
+           6: config6, 7: config7, 8: config8, 9: config9, 10: config10}
 
 
 def sweep_blocks(grid: int = 8192, dtype_name: str = "bfloat16") -> list:

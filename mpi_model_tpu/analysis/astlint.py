@@ -606,8 +606,10 @@ def check_naked_save(ctx: ModuleCtx):
 # while a pump thread dispatches: every class that owns a dispatch lock
 # must route its shared-state writes through it. This rule is the
 # structural enforcement: in any module that imports ``threading``, a
-# class that binds a lock in ``__init__`` (an attribute whose name
-# contains lock/mutex/cond/cv) may only write ``self.*`` state inside a
+# class that binds a lock ANYWHERE in its body (an attribute whose name
+# contains lock/mutex/cond/cv — __init__ or, since ISSUE 10, any other
+# method: the fleet supervisor's state made late-bound locks a real
+# shape) may only write ``self.*`` state inside a
 # ``with self.<lock>:`` block. Escapes: ``__init__`` itself
 # (construction happens-before publication), methods whose name ends in
 # ``_locked`` (the caller-holds-the-lock convention, self-documenting),
@@ -677,13 +679,19 @@ def _module_imports_threading(tree: ast.Module) -> bool:
     return False
 
 
-def _lock_attrs_bound_in_init(cls: ast.ClassDef) -> set[str]:
-    """Names of self.<attr> bound in __init__ whose attr reads as a
-    lock (``self._lock = threading.RLock()``, ``self._lock_cv = ...``)."""
+def _lock_attrs_bound_in_class(cls: ast.ClassDef) -> set[str]:
+    """Names of self.<attr> bound ANYWHERE in the class whose attr
+    reads as a lock (``self._lock = threading.RLock()``,
+    ``self._lock_cv = ...``). Originally this only scanned __init__;
+    ISSUE 10 extends it to every method so a supervisor that creates or
+    replaces a synchronization primitive outside construction (e.g. a
+    fleet respawning per-generation state) is still classified as
+    lock-owning — a lock bound late protects state exactly as much as
+    one bound in __init__, and skipping the class would silently waive
+    the whole rule for it."""
     out: set[str] = set()
     for stmt in cls.body:
-        if (isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and stmt.name == "__init__"):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for node in ast.walk(stmt):
                 for t in _self_write_targets(node):
                     if (isinstance(t, ast.Attribute)
@@ -711,10 +719,10 @@ def _under_lock_with(ctx: ModuleCtx, node: ast.AST,
 
 
 @rule("unguarded-shared-mutation", Severity.ERROR,
-      "in threaded modules, classes that bind a dispatch lock in "
-      "__init__ must write self.* state inside `with self.<lock>:` "
-      "(escapes: __init__, *_locked methods, pragma) — an unlocked "
-      "write races the pump thread",
+      "in threaded modules, classes that bind a dispatch lock "
+      "(anywhere in the class body) must write self.* state inside "
+      "`with self.<lock>:` (escapes: __init__, *_locked methods, "
+      "pragma) — an unlocked write races the pump thread",
       scope=SCOPE_PACKAGE)
 def check_unguarded_shared_mutation(ctx: ModuleCtx):
     if not _module_imports_threading(ctx.tree):
@@ -722,7 +730,7 @@ def check_unguarded_shared_mutation(ctx: ModuleCtx):
     for cls in ast.walk(ctx.tree):
         if not isinstance(cls, ast.ClassDef):
             continue
-        locks = _lock_attrs_bound_in_init(cls)
+        locks = _lock_attrs_bound_in_class(cls)
         if not locks:
             continue
         for method in cls.body:
@@ -747,6 +755,78 @@ def check_unguarded_shared_mutation(ctx: ModuleCtx):
                         "method *_locked if the caller holds the lock, "
                         "or pragma a genuinely single-threaded path "
                         "with its reason)")
+
+
+# -- wall-clock-in-test rule (ISSUE 10 satellite) ------------------------------
+# PR 9 established the zero-wall-sleeps discipline: every latency/
+# deadline/backoff path in the serving stack runs on an injectable
+# clock, so tier-1 tests drive time deterministically instead of
+# sleeping through it. This rule makes the discipline structural:
+# `time.sleep`/`time.time` in a test module is an ERROR — a test that
+# needs time passing advances a fake clock (see tests/test_serving.py's
+# `clock = {"t": ...}` idiom); a test that genuinely must touch the
+# wall (none today) pragmas the call with its reason.
+# `time.monotonic`/`time.perf_counter` stay legal: reading a clock for
+# a coarse duration bound does not make a test timing-dependent the way
+# sleeping or comparing wall timestamps does.
+
+#: the `time` module attributes whose CALL in a test is wall-clock
+#: dependence: sleeping burns tier-1 wall, and `time.time()` asserts
+#: against a clock the test does not control
+WALL_CLOCK_ATTRS = {"sleep", "time"}
+
+
+def _time_module_imports(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(function names, module aliases) bound in this module that
+    resolve to the wall clock: ``from time import sleep, time as now``
+    binds functions; ``import time`` / ``import time as _t`` binds the
+    module under a (possibly aliased) name — both spellings are the
+    same dependence and must lint the same."""
+    funcs: set[str] = set()
+    modules: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in WALL_CLOCK_ATTRS:
+                    funcs.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    modules.add(a.asname or a.name)
+    return funcs, modules
+
+
+@rule("wall-clock-in-test", Severity.ERROR,
+      "`time.sleep`/`time.time` in tests/ couples the suite to the "
+      "wall clock — drive the injectable clock instead (pragma a "
+      "genuine wall dependency with its reason)",
+      scope=SCOPE_TESTS)
+def check_wall_clock_in_test(ctx: ModuleCtx):
+    # only calls through an ACTUAL time import count: in a module that
+    # never imports time, a name `time` is a local binding (e.g. a
+    # fake-clock fixture — the very idiom this rule recommends), and
+    # flagging it would be a false-positive ERROR
+    from_imports, module_names = _time_module_imports(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        hit = None
+        if (isinstance(fn, ast.Attribute) and fn.attr in WALL_CLOCK_ATTRS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in module_names):
+            hit = f"{fn.value.id}.{fn.attr}"
+        elif isinstance(fn, ast.Name) and fn.id in from_imports:
+            hit = fn.id
+        if hit is not None:
+            yield Finding(
+                "wall-clock-in-test", Severity.ERROR, ctx.path,
+                node.lineno,
+                f"`{hit}(...)` in a test module — tests drive the "
+                "injectable clock (a fake `clock`/`sleep` advancing a "
+                "dict value), never the wall; sleeping fattens the "
+                "tier-1 wall and wall-time asserts flake (pragma a "
+                "genuine wall dependency with its reason)")
 
 
 def audit_test_module(path) -> list[str]:

@@ -316,20 +316,29 @@ def _run_serve(args, space, model) -> int:
     with an open-loop arrival process — ``--serve-scenarios`` copies of
     the configured scenario arriving at ``--arrival-rate`` per second
     (0/unset = open throttle) against a ``--max-queue``-bounded
-    admission queue with optional per-ticket ``--deadline-s``. Reports
-    the serving ledger (served/failed/expired/shed — complete by
-    construction, exit 1 if not), sustained scenarios/s, p50/p99 queue
-    latency and device occupancy."""
-    from .ensemble import AsyncEnsembleService, buckets_for, run_soak
+    admission queue with optional per-ticket ``--deadline-s``.
+    ``--serve-services N`` (ISSUE 10) shards the same arrival stream
+    over an N-member ``FleetSupervisor`` (structure-affine routing,
+    member fencing + restart, per-member attribution in the JSON row).
+    Reports the serving ledger (served/failed/expired/shed — complete
+    by construction, exit 1 if not), sustained scenarios/s, p50/p99
+    queue latency and device occupancy."""
+    from .ensemble import (AsyncEnsembleService, FleetSupervisor,
+                           buckets_for, run_soak)
 
     steps = args.steps if args.steps is not None else model.num_steps
     n = args.serve_scenarios
-    svc = AsyncEnsembleService(
-        model, steps=steps, impl=args.ensemble_impl,
+    svc_kw = dict(
+        steps=steps, impl=args.ensemble_impl,
         substeps=args.substeps, buckets=buckets_for(8),
         max_queue=args.max_queue, compute_dtype=_compute_dtype(args),
         deadline_s=args.deadline_s, retry="solo",
         compile_cache=_cache_spec(args, "auto"))
+    if args.serve_services > 1:
+        svc = FleetSupervisor(model, services=args.serve_services,
+                              **svc_kw)
+    else:
+        svc = AsyncEnsembleService(model, **svc_kw)
     rate = args.arrival_rate if args.arrival_rate else 1e9
     with svc:
         rep = run_soak(svc, [(space, None, None)] * n,
@@ -340,6 +349,7 @@ def _run_serve(args, space, model) -> int:
         "steps": steps,
         "max_queue": args.max_queue,
         "deadline_s": args.deadline_s,
+        "services": args.serve_services,
         **{k: rep[k] for k in (
             "offered", "served", "failed", "expired", "shed",
             "ledger_complete", "wall_s", "sustained_scenarios_per_s",
@@ -347,13 +357,24 @@ def _run_serve(args, space, model) -> int:
             "batch_occupancy", "dispatches", "solo_retries",
             "recovered_failures", "quarantined", "loop_faults")},
     }
+    if args.serve_services > 1:
+        result["member_faults"] = rep["member_faults"]
+        result["readmitted"] = rep["readmitted"]
+        # per-member attribution (the service_id satellite): enough for
+        # an operator to see which member served what
+        result["members"] = [
+            {k: s[k] for k in ("service_id", "scenarios", "dispatches",
+                               "pending", "gen")}
+            for s in rep["services"]]
     if args.json:
         print(json.dumps(result, allow_nan=False))
     else:
         sps = rep["sustained_scenarios_per_s"]
         p99 = rep["latency_p99_s"]
         p99_s = "n/a" if p99 is None else f"{p99:.4f}s"
-        print(f"backend=serve impl={args.ensemble_impl} "
+        fleet_note = (f" services={args.serve_services}"
+                      if args.serve_services > 1 else "")
+        print(f"backend=serve impl={args.ensemble_impl}{fleet_note} "
               f"served={rep['served']}/{rep['offered']} "
               f"shed={rep['shed']} expired={rep['expired']} "
               f"failed={rep['failed']} "
@@ -442,6 +463,9 @@ def cmd_run(args) -> int:
         if args.serve_scenarios < 1:
             raise SystemExit(
                 f"--serve-scenarios={args.serve_scenarios} needs >= 1")
+        if args.serve_services < 1:
+            raise SystemExit(
+                f"--serve-services={args.serve_services} needs >= 1")
         if args.max_queue < 1:
             raise SystemExit(f"--max-queue={args.max_queue} needs >= 1")
         if args.arrival_rate is not None and args.arrival_rate < 0:
@@ -456,7 +480,8 @@ def cmd_run(args) -> int:
                 ("--arrival-rate", args.arrival_rate, None),
                 ("--deadline-s", args.deadline_s, None),
                 ("--max-queue", args.max_queue, 64),
-                ("--serve-scenarios", args.serve_scenarios, 64)):
+                ("--serve-scenarios", args.serve_scenarios, 64),
+                ("--serve-services", args.serve_services, 1)):
             if val != default:
                 raise SystemExit(
                     f"{flag} configures the always-on serving loop; "
@@ -783,6 +808,13 @@ def main(argv: Optional[list[str]] = None) -> int:
                      metavar="N",
                      help="scenarios offered to the serving loop "
                      "(default 64)")
+    run.add_argument("--serve-services", type=int, default=1,
+                     metavar="N",
+                     help="shard the arrival stream over N supervised "
+                     "always-on services (ISSUE 10 FleetSupervisor: "
+                     "structure-affine routing, member fencing + "
+                     "restart, per-member attribution); default 1 = "
+                     "the single async loop")
     run.add_argument("--arrival-rate", type=float, default=None,
                      metavar="HZ",
                      help="open-loop arrival rate in scenarios/s "

@@ -13,11 +13,18 @@ compile cache.
                   ``AsyncEnsembleService`` dispatch loop (ISSUE 9:
                   double-buffered launch/finish, bounded admission with
                   ``ServiceOverloaded`` shedding, donated inter-window
-                  state), plus the ``run_soak`` open-loop driver.
+                  state), plus the ``run_soak`` open-loop driver;
+- ``fleet``     — the ``FleetSupervisor`` (ISSUE 10): one arrival
+                  stream sharded over N async members with
+                  structure-affine routing, autoscaling, failure-domain
+                  isolation (fence + restart + re-admit) and
+                  crash-restart ticket recovery;
+- ``journal``   — the append-only CRC'd ticket journal behind
+                  ``FleetSupervisor.recover``.
 
-See docs/DESIGN.md "Ensemble serving" / "Always-on serving" for why the
-batch axis sits OUTSIDE the mesh axes and how the loop overlaps host
-assembly with device compute.
+See docs/DESIGN.md "Ensemble serving" / "Always-on serving" / "Fleet
+supervision" for why the batch axis sits OUTSIDE the mesh axes and how
+the loop overlaps host assembly with device compute.
 """
 
 from .batch import (
@@ -30,14 +37,22 @@ from .batch import (
     run_ensemble,
     structure_key,
 )
+from .fleet import AutoscalePolicy, FleetSupervisor, MemberFailure
+from .journal import TicketJournal
 from .scheduler import (DEFAULT_BUCKETS, DispatchTimeout,
-                        EnsembleScheduler, TicketExpired, buckets_for)
+                        EnsembleScheduler, TicketExpired,
+                        TicketNotMigratable, buckets_for)
 from .service import (AsyncEnsembleService, EnsembleService,
                       ServiceOverloaded, run_soak)
 
 __all__ = [
     "AsyncEnsembleService",
+    "AutoscalePolicy",
     "DispatchTimeout",
+    "FleetSupervisor",
+    "MemberFailure",
+    "TicketJournal",
+    "TicketNotMigratable",
     "EnsembleConservationError",
     "EnsembleExecutor",
     "EnsembleInFlight",

@@ -1,0 +1,362 @@
+"""Append-only CRC'd ticket journal — the crash-restart half of the
+fleet supervisor (ISSUE 10 tentpole, layer 3).
+
+PR 9's "zero silent drops" contract holds only while the process lives:
+every submitted ticket resolves to exactly one of result / quarantine /
+expiry / shed — in memory. A hard kill voids all of it. This module
+makes the ledger durable: the fleet writes one journal record at each
+scheduler seam a ticket crosses —
+
+==============  =============================================================
+kind            written when / carries
+==============  =============================================================
+``submit``      a ticket was ADMITTED (after the member accepted it, so
+                a crash in the admission window can never replay a shed
+                submission): ticket id, member ``service_id``, steps,
+                the scenario model's numeric parameters and the full
+                channel state (per-array CRC32)
+``served``      the fleet harvested a result: final channel state +
+                conservation totals — a served-but-unacknowledged
+                ticket resolves FROM THE JOURNAL after a restart,
+                without re-running the scenario
+``quarantined``/
+``expired``     the ticket resolved as a failure: kind + detail, enough
+                to reconstruct the error (and the ledger line) exactly
+``shed``        an admission was refused fleet-wide (no ticket was ever
+                issued; recorded for the audit trail only)
+``readmit``/
+``migrate``     non-terminal attribution: a ticket moved to another
+                member (fencing, retirement, crash-restart recovery)
+==============  =============================================================
+
+Record format (the PR 5/6 checkpoint discipline applied to a log):
+every record is ``b"TJ1 <len:08x> <crc:08x>\\n" + payload + b"\\n"``
+where the CRC32 covers the whole payload; a payload is the record's
+JSON metadata, optionally followed by ``b"\\x00"`` and a raw binary
+blob whose slices are described — with their OWN per-array CRC32s — by
+the metadata's ``arrays`` table. The reader verifies record CRCs in
+order and STOPS at the first record that fails to parse or verify: a
+torn tail (the classic crash shape, and the ``journal_torn`` chaos
+fault) costs exactly the unverifiable suffix, never the verified
+prefix, and never a wrong byte admitted as state. Opening a journal for
+append first truncates it back to its verified prefix, so recovery
+writes always extend good data.
+
+``replay`` folds the verified records into per-ticket outcomes:
+``unresolved()`` (submitted, no terminal record) is exactly the set
+``FleetSupervisor.recover`` re-admits; a second recovery of a journal
+whose first recovery ran to completion finds nothing unresolved — the
+idempotence the tests pin.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from ..core.cellular_space import CellularSpace
+from ..models.model import Model
+from ..resilience import inject
+
+__all__ = [
+    "TicketJournal",
+    "JournalRecord",
+    "JournalState",
+    "read_records",
+    "replay",
+    "journal_path",
+    "space_payload",
+    "space_from_record",
+    "model_meta",
+    "model_from_meta",
+    "TERMINAL_KINDS",
+]
+
+#: record kinds that RESOLVE a ticket (everything else is attribution)
+TERMINAL_KINDS = ("served", "quarantined", "expired")
+
+_MAGIC = b"TJ1 "
+_HEADER_RE = re.compile(rb"^TJ1 ([0-9a-f]{8}) ([0-9a-f]{8})\n$")
+_HEADER_LEN = 22  # b"TJ1 " + 8 hex + b" " + 8 hex + b"\n"
+
+#: the journal file name inside a journal directory (one stream per
+#: fleet; recovery appends to the same file, so the whole history of a
+#: slot — original run + every restart — reads as one ledger)
+JOURNAL_NAME = "tickets.journal"
+
+
+def journal_path(journal_dir: str) -> str:
+    return os.path.join(journal_dir, JOURNAL_NAME)
+
+
+@dataclasses.dataclass
+class JournalRecord:
+    """One verified journal record: its 0-based ``index`` in the file,
+    the ``kind``, the JSON ``meta`` and the materialized (CRC-verified)
+    ``arrays``, if the record carried state."""
+
+    index: int
+    kind: str
+    meta: dict
+    arrays: Optional[dict] = None
+
+    @property
+    def ticket(self) -> Optional[int]:
+        return self.meta.get("ticket")
+
+
+class TicketJournal:
+    """Append handle over one journal file. NOT internally locked: the
+    fleet serializes every append under its own supervisor lock (the
+    journal is a seam of the fleet, not a shared service)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._count = 0
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            # truncate a torn tail back to the verified prefix so every
+            # append extends good data (recover-then-append safety)
+            records, _, verified_len = _scan(path)
+            self._count = len(records)
+            if verified_len < os.path.getsize(path):
+                with open(path, "r+b") as fh:
+                    fh.truncate(verified_len)
+        self._fh = open(path, "ab")
+
+    @property
+    def count(self) -> int:
+        """Records appended so far (verified prefix + this handle's)."""
+        return self._count
+
+    def append(self, kind: str, meta: Optional[dict] = None,
+               arrays: Optional[dict] = None) -> int:
+        """Write one CRC'd record and flush; returns its index. The
+        ``journal_torn`` chaos seam fires AFTER the write, with the
+        record's byte offset, so a torn-tail fault lands exactly where
+        a real mid-record crash would."""
+        body = dict(meta or {})
+        body["kind"] = kind
+        blob = b""
+        if arrays is not None:
+            table = {}
+            parts = []
+            off = 0
+            for name in sorted(arrays):
+                a = np.ascontiguousarray(np.asarray(arrays[name]))
+                raw = a.tobytes()
+                table[name] = {
+                    "dtype": str(a.dtype), "shape": list(a.shape),
+                    "offset": off, "nbytes": len(raw),
+                    "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+                }
+                parts.append(raw)
+                off += len(raw)
+            body["arrays"] = table
+            blob = b"\x00" + b"".join(parts)
+        payload = json.dumps(body, sort_keys=True).encode() + blob
+        header = b"TJ1 %08x %08x\n" % (
+            len(payload), zlib.crc32(payload) & 0xFFFFFFFF)
+        start = self._fh.tell()
+        self._fh.write(header + payload + b"\n")
+        self._fh.flush()
+        idx = self._count
+        self._count += 1
+        inject.journal_torn(self.path, idx, start)
+        return idx
+
+    def close(self) -> None:
+        if not self._fh.closed:
+            self._fh.close()
+
+    def __enter__(self) -> "TicketJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _parse_record(index: int, payload: bytes) -> JournalRecord:
+    cut = payload.find(b"\x00")
+    meta_bytes = payload if cut < 0 else payload[:cut]
+    meta = json.loads(meta_bytes.decode())
+    arrays = None
+    if "arrays" in meta:
+        if cut < 0:
+            raise ValueError("record declares arrays but carries no blob")
+        blob = payload[cut + 1:]
+        arrays = {}
+        for name, spec in meta["arrays"].items():
+            raw = blob[spec["offset"]:spec["offset"] + spec["nbytes"]]
+            if len(raw) != spec["nbytes"]:
+                raise ValueError(f"array {name!r} blob slice short")
+            if (zlib.crc32(raw) & 0xFFFFFFFF) != spec["crc32"]:
+                raise ValueError(
+                    f"array {name!r} failed its per-array CRC32")
+            arrays[name] = np.frombuffer(
+                raw, dtype=np.dtype(spec["dtype"])
+            ).reshape(tuple(spec["shape"])).copy()
+    return JournalRecord(index, meta["kind"], meta, arrays)
+
+
+def _scan(path: str) -> tuple[list[JournalRecord], bool, int]:
+    """(verified records, torn?, verified byte length): parse records
+    in order, stopping at the first header/CRC/decode failure — the
+    recover-up-to-last-CRC-verified-entry contract."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    records: list[JournalRecord] = []
+    pos = 0
+    while pos < len(data):
+        header = data[pos:pos + _HEADER_LEN]
+        m = _HEADER_RE.match(header)
+        if m is None:
+            return records, True, pos
+        n = int(m.group(1), 16)
+        want = int(m.group(2), 16)
+        payload = data[pos + _HEADER_LEN:pos + _HEADER_LEN + n]
+        end = pos + _HEADER_LEN + n + 1
+        if (len(payload) != n or end > len(data)
+                or data[end - 1:end] != b"\n"
+                or (zlib.crc32(payload) & 0xFFFFFFFF) != want):
+            return records, True, pos
+        try:
+            records.append(_parse_record(len(records), payload))
+        except (ValueError, KeyError, json.JSONDecodeError):
+            return records, True, pos
+        pos = end
+    return records, False, pos
+
+
+def read_records(path: str) -> tuple[list[JournalRecord], bool]:
+    """Every CRC-verified record in order, plus whether the file had a
+    torn/corrupt tail (the suffix after the last verified record)."""
+    if not os.path.exists(path):
+        return [], False
+    records, torn, _ = _scan(path)
+    return records, torn
+
+
+@dataclasses.dataclass
+class JournalState:
+    """The journal folded to per-ticket outcomes."""
+
+    #: ticket → its submit record (state + model + steps)
+    submits: dict
+    #: ticket → its FIRST terminal record (served/quarantined/expired)
+    terminal: dict
+    #: tickets that appeared with MORE than one terminal record — a
+    #: duplicate-resolution audit failure (must stay empty)
+    duplicate_terminals: list
+    #: fleet-level admission refusals recorded (no ticket issued)
+    shed: int
+    #: the file had a torn tail (the suffix was discarded)
+    torn: bool
+
+    def unresolved(self) -> list[int]:
+        """Tickets submitted but never resolved — what recovery
+        re-admits, in submit order."""
+        return [t for t in self.submits if t not in self.terminal]
+
+    def max_ticket(self) -> int:
+        return max(self.submits, default=-1)
+
+
+def replay(path: str) -> JournalState:
+    records, torn = read_records(path)
+    submits: dict = {}
+    terminal: dict = {}
+    dup: list = []
+    shed = 0
+    for rec in records:
+        if rec.kind == "submit":
+            submits[rec.ticket] = rec
+        elif rec.kind in TERMINAL_KINDS:
+            if rec.ticket in terminal:
+                dup.append(rec.ticket)
+            else:
+                terminal[rec.ticket] = rec
+        elif rec.kind == "shed":
+            shed += 1
+    return JournalState(submits=submits, terminal=terminal,
+                        duplicate_terminals=dup, shed=shed, torn=torn)
+
+
+# -- scenario (space/model) serialization -------------------------------------
+
+def space_payload(space: CellularSpace) -> tuple[dict, dict]:
+    """(meta, arrays) for a FULL-grid scenario space — what a submit or
+    served record carries. Partitions never reach the ensemble engine
+    (``EnsembleSpace.stack`` refuses them), so geometry is dims only."""
+    arrays = {k: np.asarray(v) for k, v in space.values.items()}
+    return {"dim_x": space.dim_x, "dim_y": space.dim_y}, arrays
+
+
+def space_from_record(rec: JournalRecord) -> CellularSpace:
+    """Materialize the record's CRC-verified channel state."""
+    import jax.numpy as jnp
+
+    if rec.arrays is None:
+        raise ValueError(
+            f"record {rec.index} ({rec.kind}) carries no state arrays")
+    vals = {k: jnp.asarray(a) for k, a in rec.arrays.items()}
+    return CellularSpace(vals, rec.meta["dim_x"], rec.meta["dim_y"])
+
+
+_SCALAR = (int, float, str, bool, type(None))
+
+
+def model_meta(model) -> Optional[dict]:
+    """JSON-able reconstruction recipe for a model whose flows are
+    dataclasses of scalar (or int-tuple) fields — every flow the
+    package ships. None when a flow carries something richer (a user
+    subclass holding a Cell/array): recovery then falls back to the
+    fleet's template model, with a warning."""
+    import dataclasses as _dc
+
+    flows = []
+    for f in model.flows:
+        if not _dc.is_dataclass(f):
+            return None
+        params = {}
+        for fld in _dc.fields(f):
+            v = getattr(f, fld.name)
+            if isinstance(v, tuple) and all(
+                    isinstance(e, (int, float)) for e in v):
+                params[fld.name] = {"__tuple__": list(v)}
+            elif isinstance(v, _SCALAR):
+                params[fld.name] = v
+            else:
+                return None
+        flows.append({"type": type(f).__name__, "params": params})
+    return {"flows": flows, "time": model.time,
+            "time_step": model.time_step,
+            "offsets": [list(o) for o in model.offsets]}
+
+
+def model_from_meta(meta: Optional[dict], template=None):
+    """Rebuild the model a submit record described; ``template`` when
+    the record carried none (see ``model_meta``)."""
+    if meta is None:
+        return template
+    from ..ops import flow as flow_mod
+
+    flows = []
+    for fm in meta["flows"]:
+        cls = getattr(flow_mod, fm["type"], None)
+        if not (isinstance(cls, type) and issubclass(cls, flow_mod.Flow)):
+            raise ValueError(
+                f"journal names unknown flow type {fm['type']!r}")
+        params = {
+            k: tuple(v["__tuple__"])
+            if isinstance(v, dict) and "__tuple__" in v else v
+            for k, v in fm["params"].items()}
+        flows.append(cls(**params))
+    return Model(flows, meta["time"], meta["time_step"],
+                 offsets=[tuple(o) for o in meta["offsets"]])

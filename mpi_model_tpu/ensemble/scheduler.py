@@ -112,6 +112,15 @@ class TicketExpired(RuntimeError):
     outcome, never a silent drop."""
 
 
+class TicketNotMigratable(RuntimeError):
+    """``migrate_ticket`` found the ticket PENDING but not QUEUED — it
+    is inside a claimed/launched dispatch (ISSUE 10 satellite: with an
+    async pump running concurrently this is a normal state, not a bug).
+    Migrating it would risk a double dispatch, so the caller is told to
+    wait for the in-flight resolution (or re-admit from its own copy of
+    the state once the source member is known dead) instead."""
+
+
 def buckets_for(n: int) -> tuple[int, ...]:
     """Power-of-two bucket ladder covering batches up to ``n``."""
     out = [1]
@@ -175,7 +184,8 @@ class EnsembleScheduler:
                  retry_budget: Optional[int] = None,
                  windows: int = 1, donate: bool = False,
                  inline_dispatch: bool = True,
-                 compile_cache: Optional[str] = "auto"):
+                 compile_cache: Optional[str] = "auto",
+                 service_id: Optional[str] = None):
         from ..utils.compile_cache import (configure_compile_cache,
                                            resolve_compile_cache)
 
@@ -209,6 +219,11 @@ class EnsembleScheduler:
         self.rtol = rtol
         self.counter = counter if counter is not None else ThroughputCounter()
         self._clock = clock
+        #: stable identity of the serving member this scheduler belongs
+        #: to (ISSUE 10 satellite): stamped into stats(), every served
+        #: backend_report and every FailureEvent, so multi-service logs
+        #: are attributable per member. None = standalone scheduler.
+        self.service_id = service_id
         #: "none" (first failure surfaces at poll — the pre-ISSUE-5
         #: behavior) or "solo" (retry-with-quarantine, module docstring)
         self.retry = retry
@@ -288,6 +303,28 @@ class EnsembleScheduler:
         with self._lock:
             return len(self._pending_tickets)
 
+    def due_backlog(self) -> bool:
+        """True when some queued group is DUE (full, or its oldest
+        submission has waited past ``max_wait_s``) — work a healthy
+        pump would be making progress on RIGHT NOW. A partial bucket
+        inside its max-wait window is not due: the fleet's wedge
+        detector must not fence a member for legitimately waiting out
+        its batching policy."""
+        with self._lock:
+            now = self._clock()
+            for q in self._queues.values():
+                if q and (len(q) >= self.max_batch
+                          or (now - q[0].submitted_at) >= self.max_wait_s):
+                    return True
+            return False
+
+    def queued_tickets(self) -> list[int]:
+        """Tickets still in a queue (submitted, not yet claimed into a
+        dispatch) — exactly the set ``migrate_ticket`` can move; the
+        fleet's drain-before-retire and fencing paths iterate it."""
+        with self._lock:
+            return [it.ticket for q in self._queues.values() for it in q]
+
     def poll(self, ticket: int, pump: bool = True):
         """Result for ``ticket`` if served (due groups are pumped
         first): ``(space, Report)``; ``None`` while queued; raises the
@@ -355,7 +392,7 @@ class EnsembleScheduler:
             step=it.steps, kind="expired",
             detail=str(err), rolled_back_to=0, attempt=1,
             wall_time_s=0.0, classification="deterministic",
-            ticket=it.ticket)
+            ticket=it.ticket, service_id=self.service_id)
         err.ticket = it.ticket
         err.failure_event = ev
         self.expired_log.append(ev)
@@ -477,9 +514,16 @@ class EnsembleScheduler:
                         break
                 if found:
                     break
-            if found is None:  # pragma: no cover - pending implies queued
-                raise KeyError(
-                    f"ticket {ticket} is pending but not queued")
+            if found is None:
+                # ISSUE 10 satellite: with an async pump running, a
+                # pending-but-not-queued ticket is mid-launch (claimed
+                # into a dispatch) — migrating it would double-dispatch
+                # the scenario; report it as such and leave it alone
+                raise TicketNotMigratable(
+                    f"ticket {ticket} is inside a claimed/launched "
+                    "dispatch — not migratable without risking a double "
+                    "dispatch; collect its result (or re-admit it only "
+                    "once its member is known dead)")
             key, i, it = found
             from ..io.delta import transfer_space
 
@@ -664,18 +708,23 @@ class EnsembleScheduler:
             # degraded) engine is serving again
             self.intake_gated = False
             degraded = self.degraded_from
-        if degraded is not None:
+        if degraded is not None or self.service_id is not None:
             # per-row honesty: results served by a degraded engine say
             # so — a consumer must never believe pipeline/active served
-            # them after the ladder swapped the engine out
+            # them after the ladder swapped the engine out; and every
+            # served report names the member that produced it
+            # (ISSUE 10: multi-service logs must be attributable)
+            extra = {}
+            if degraded is not None:
+                extra = {"impl": self.executor.impl,
+                         "degraded_from": degraded}
+            if self.service_id is not None:
+                extra["service_id"] = self.service_id
             for res in results:
                 if not isinstance(res, Exception):
                     rep = res[1]
                     rep.backend_report = {
-                        **(rep.backend_report or {}),
-                        "impl": self.executor.impl,
-                        "degraded_from": degraded,
-                    }
+                        **(rep.backend_report or {}), **extra}
         return results, None, flight.cache_hit, wall
 
     def _execute_batch(self, items: list, bucket: int):
@@ -882,7 +931,8 @@ class EnsembleScheduler:
             step=it.steps, kind=kind,
             detail=detail,
             rolled_back_to=0, attempt=attempts, wall_time_s=0.0,
-            classification="deterministic", ticket=it.ticket)
+            classification="deterministic", ticket=it.ticket,
+            service_id=self.service_id)
         with self._lock:
             self.quarantine_log.append(ev)
         self.counter.bump("quarantined")
@@ -951,5 +1001,6 @@ class EnsembleScheduler:
                 "intake_gated": self.intake_gated,
                 "migrated_out": self.migrated_out,
                 "migrated_in": self.migrated_in,
+                "service_id": self.service_id,
             })
             return out

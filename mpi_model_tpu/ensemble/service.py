@@ -104,10 +104,14 @@ class EnsembleService:
                  ticket_deadline_s: Optional[float] = None,
                  retry_budget: Optional[int] = None,
                  windows: int = 1, donate: bool = False,
-                 compile_cache: Optional[str] = "auto"):
+                 compile_cache: Optional[str] = "auto",
+                 service_id: Optional[str] = None):
         self.model = model
         self.default_steps = (model.num_steps if steps is None
                               else int(steps))
+        #: stable member identity (ISSUE 10 satellite) — stamped into
+        #: stats/backend_reports/FailureEvents by the scheduler
+        self.service_id = service_id
         self.scheduler = EnsembleScheduler(
             impl=impl, substeps=substeps, buckets=buckets,
             max_wait_s=max_wait_s, max_batch=max_batch,
@@ -119,7 +123,7 @@ class EnsembleService:
             ticket_deadline_s=ticket_deadline_s,
             retry_budget=retry_budget,
             windows=windows, donate=donate,
-            compile_cache=compile_cache)
+            compile_cache=compile_cache, service_id=service_id)
         #: the persistent-cache dir actually armed (None = disabled or
         #: unsupported by this jax — the service still serves)
         self.compile_cache = self.scheduler.compile_cache
@@ -202,13 +206,18 @@ class AsyncEnsembleService:
                  retry_budget: Optional[int] = None,
                  windows: int = 1, donate: bool = True,
                  compile_cache: Optional[str] = "auto",
-                 start: bool = True, poll_interval_s: float = 0.02):
+                 start: bool = True, poll_interval_s: float = 0.02,
+                 service_id: Optional[str] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue={max_queue} must be >= 1")
         self.model = model
         self.default_steps = (model.num_steps if steps is None
                               else int(steps))
         self.max_queue = int(max_queue)
+        #: stable member identity (ISSUE 10 satellite): the fleet names
+        #: its members ("m<slot>g<gen>"); the member chaos faults
+        #: (member_kill/member_wedge) target it by this id
+        self.service_id = service_id
         self.scheduler = EnsembleScheduler(
             impl=impl, substeps=substeps, buckets=buckets,
             max_wait_s=max_wait_s, max_batch=max_batch,
@@ -220,7 +229,8 @@ class AsyncEnsembleService:
             ticket_deadline_s=deadline_s,
             retry_budget=retry_budget,
             windows=windows, donate=donate,
-            inline_dispatch=False, compile_cache=compile_cache)
+            inline_dispatch=False, compile_cache=compile_cache,
+            service_id=service_id)
         self.compile_cache = self.scheduler.compile_cache
         self._poll_interval = float(poll_interval_s)
         #: condition guarding the loop state below (its lock is the
@@ -228,6 +238,9 @@ class AsyncEnsembleService:
         self._lock_cv = threading.Condition()
         self._inflight = None
         self._stop = False
+        #: abandon(): the loop must EXIT NOW, no drain — distinct from
+        #: _stop, which the loop reads as "drain then exit"
+        self._abandoned = False
         self._thread: Optional[threading.Thread] = None
         #: most recent supervised pump-loop failures (bounded)
         self.loop_errors: list = []
@@ -241,6 +254,10 @@ class AsyncEnsembleService:
         with self._lock_cv:
             if self._thread is not None:
                 return
+            if self._abandoned:
+                raise RuntimeError(
+                    "this service was abandoned (fleet fencing) — "
+                    "build a fresh one instead of restarting it")
             self._stop = False
             t = threading.Thread(target=self._loop, daemon=True,
                                  name="ensemble-dispatch")
@@ -273,6 +290,42 @@ class AsyncEnsembleService:
                     break
         with self._lock_cv:
             self._stop = False
+
+    def abandon(self) -> None:
+        """Signal the loop to EXIT NOW — no drain, no join: the fleet
+        supervisor's escape hatch for a failed member (``stop`` would
+        drain, and a wedged pump never drains; a drain would also keep
+        dispatching work the fleet has already re-admitted elsewhere).
+        The abandoned flag is checked at the top of every loop
+        iteration, so the daemon thread exits at its next wakeup even
+        mid-backlog; unresolved tickets are the caller's to re-admit
+        (the fleet does, from its journaled/stored state). Abandonment
+        is final: the service cannot be ``start()``-ed again — the
+        fleet replaces the member instead."""
+        with self._lock_cv:
+            self._stop = True
+            self._abandoned = True
+            self._thread = None
+            self._lock_cv.notify_all()
+
+    def is_alive(self) -> bool:
+        """True while the dispatch thread exists and is running (manual
+        mode has no thread and reports False) — the fleet's dead-pump
+        probe."""
+        with self._lock_cv:
+            t = self._thread
+        return t is not None and t.is_alive()
+
+    def has_work_due(self) -> bool:
+        """True when the pump SHOULD be making progress right now: a
+        launched flight is outstanding, or a queued group is due
+        (full / past max-wait). The fleet's wedge detector keys on
+        this — pending work that is merely waiting out the batching
+        policy is not evidence of a wedge."""
+        with self._lock_cv:
+            if self._inflight is not None:
+                return True
+        return self.scheduler.due_backlog()
 
     def __enter__(self) -> "AsyncEnsembleService":
         return self
@@ -401,6 +454,15 @@ class AsyncEnsembleService:
         an unwind cannot drop a ticket silently."""
         st = inject.active()
         if st is not None:
+            # the member faults fire BEFORE the pump counter moves, so
+            # a wedged member's thread_exc indices stay deterministic
+            if st.member_fault(self.service_id,
+                               ("member_wedge",)) is not None:
+                return False  # a live thread making zero progress
+            if st.member_fault(self.service_id,
+                               ("member_kill",)) is not None:
+                raise inject.MemberKilled(
+                    f"injected member kill ({self.service_id})")
             f = st.take("pump", st.bump("pump"), kinds=("thread_exc",))
             if f is not None:
                 raise inject.InjectedFault(
@@ -425,8 +487,17 @@ class AsyncEnsembleService:
         while True:
             try:
                 with self._lock_cv:
+                    if self._abandoned:
+                        return  # exit NOW: no drain (see abandon())
                     draining = self._stop
                 did = self.pump_once(force=draining)
+            except inject.MemberKilled:
+                # the member_kill chaos fault: this thread DIES — no
+                # drain, no supervision, exactly like a real thread
+                # death (the fleet's health check is what must notice);
+                # returning (vs propagating) only spares the noisy
+                # default excepthook traceback
+                return
             # analysis: ignore[broad-except] — the pump-loop supervisor:
             # a dispatch-thread exception (chaos thread_exc included)
             # must be counted and survived — a dead loop is a dead
@@ -498,7 +569,15 @@ def run_soak(service, scenarios, *, arrival_rate_hz: float,
     wall = clock() - t0
     st = service.stats()
     offered = len(scenarios)
+    fleet_fields = (
+        # fleet mode (ISSUE 10): per-member attribution + the
+        # supervision ledger ride along so the soak report reconciles
+        # ACROSS members, not just in aggregate
+        {k: st[k] for k in ("services", "member_faults", "readmitted",
+                            "scale_ups", "scale_downs")}
+        if "services" in st else {})
     return {
+        **fleet_fields,
         "offered": offered,
         "arrival_rate_hz": arrival_rate_hz,
         "served": served,

@@ -47,6 +47,21 @@ site           where the seam lives / what the fault does
                one submission behave as if the queue were full
                (``ServiceOverloaded`` shed), exercising the overflow
                path without needing real backlog
+``pump``       fleet member faults (ISSUE 10) — ``kind="member_kill"``
+               raises :class:`MemberKilled` (a BaseException: it must
+               escape the pump loop's supervisor — a killed member is
+               DEAD, not a survivable loop fault) so the member's
+               dispatch thread dies; ``kind="member_wedge"``
+               (``once=False``) makes every pump iteration a no-op — a
+               live thread making zero progress. Both target ONE member
+               by ``channel`` = its ``service_id`` (None matches any
+               member), so a restarted member (new generation, new id)
+               is born un-faulted.
+``journal``    the fleet ticket journal — ``kind="journal_torn"``
+               tears/corrupts the journal file right after record ``at``
+               is appended (``offset`` is relative to that record's
+               start), driving the recover-up-to-last-verified-entry
+               path
 =============  ==============================================================
 
 Zero overhead when disarmed: every seam starts with one module-global
@@ -72,12 +87,14 @@ __all__ = [
     "FaultPlan",
     "ArmedPlan",
     "InjectedFault",
+    "MemberKilled",
     "armed",
     "active",
     "halo_perturbation",
     "build_token",
     "poison_values",
     "checkpoint_torn",
+    "journal_torn",
     "tear_file",
 ]
 
@@ -86,6 +103,15 @@ class InjectedFault(RuntimeError):
     """The exception an armed ``exc``/``batch_exc`` fault raises — a
     distinct type so tests and supervisors can tell injected chaos from
     a genuine failure leaking through the same path."""
+
+
+class MemberKilled(BaseException):
+    """The ``member_kill`` fault (ISSUE 10): deliberately a
+    ``BaseException`` so the async pump loop's ``except Exception``
+    supervisor does NOT survive it — the member's dispatch thread dies,
+    which is exactly the failure domain the fleet supervisor must
+    detect, fence and restart. Only the fleet's own pump wrapper (manual
+    mode) catches it, to mark the member dead."""
 
 
 #: fault kind → seam site (one table, so a typo'd kind fails at plan
@@ -103,6 +129,10 @@ SITE_OF = {
     "slow_compile": "assemble",
     "fetch_nan": "fetch",
     "queue_full": "admission",
+    # ISSUE 10: the fleet-supervision seams
+    "member_kill": "pump",
+    "member_wedge": "pump",
+    "journal_torn": "journal",
 }
 
 
@@ -118,9 +148,16 @@ class Fault:
 
     kind: str
     #: seam firing index (None = first opportunity); for "torn" this is
-    #: the checkpoint step being written
+    #: the checkpoint step being written; for the member faults
+    #: ("member_kill"/"member_wedge") it is a THRESHOLD, not an index:
+    #: the fault is eligible only once the pump site has been visited
+    #: at least ``at`` times fleet-wide — how a chaos plan lands a kill
+    #: MID-soak instead of at the first pump after arming
     at: Optional[int] = None
-    #: channel to poison ("nan"/"lane_nan"; None → first channel)
+    #: channel to poison ("nan"/"lane_nan"; None → first channel). The
+    #: member faults ("member_kill"/"member_wedge") reuse this as the
+    #: TARGET ``service_id`` (None = any member), and "journal_torn"/
+    #: "torn" as the part name being written
     channel: Optional[str] = None
     #: cell to poison (None → (0, 0))
     cell: Optional[tuple] = None
@@ -149,6 +186,17 @@ class Fault:
                 f"(expected one of {sorted(SITE_OF)})")
         if self.tear not in ("truncate", "corrupt"):
             raise ValueError(f"unknown tear mode {self.tear!r}")
+        if self.kind in ("member_kill", "member_wedge"):
+            if self.kind == "member_wedge" and not self.once \
+                    and self.channel is None:
+                # an unpinned sticky wedge would re-wedge every
+                # replacement generation: fence → restart → wedge,
+                # forever — pin the member it wedges
+                raise ValueError(
+                    "a sticky member_wedge (once=False) must pin its "
+                    "member via channel=service_id — unpinned it would "
+                    "wedge every replacement generation too, an "
+                    "unbounded fence/restart loop")
 
     @property
     def site(self) -> str:
@@ -215,6 +263,26 @@ class ArmedPlan:
                 continue
             if f.ticket is not None:
                 continue  # ticket faults fire via ticket_fault only
+            self._fire(i, f)
+            return f
+        return None
+
+    def member_fault(self, service_id, kinds: tuple) -> Optional[Fault]:
+        """Live member fault (``member_kill``/``member_wedge``) aimed at
+        ``service_id``: a fault whose ``channel`` is None (any member)
+        or equals the id, and whose ``at`` threshold — a minimum
+        fleet-wide pump-site visit count, for mid-soak timing — has
+        been reached. Consumed per ``once`` — a sticky wedge
+        (``once=False``, channel-pinned by construction) re-fires every
+        pump until its member is restarted under a new id."""
+        pumps = self._counters.get("pump", 0)
+        for i, f in enumerate(self.plan.faults):
+            if f.kind not in kinds or i in self._consumed:
+                continue
+            if f.channel is not None and f.channel != service_id:
+                continue
+            if f.at is not None and pumps < f.at:
+                continue
             self._fire(i, f)
             return f
         return None
@@ -366,6 +434,26 @@ def checkpoint_torn(path: str, step: int, part: str = "data") -> None:
             continue
         st._fire(i, f)
         tear_file(path, f.offset, f.nbytes, f.tear)
+        return
+
+
+def journal_torn(path: str, index: int, record_start: int) -> None:
+    """Ticket-journal seam (ISSUE 10): tear/corrupt the fleet journal
+    right after record ``index`` was appended. The fault's ``offset`` is
+    RELATIVE to the just-written record's byte start, so ``tear=
+    "truncate", offset=3`` models a write torn mid-record (the classic
+    crash shape) and the reader's recover-up-to-last-CRC-verified-entry
+    contract is what the matrix asserts."""
+    st = _ACTIVE
+    if st is None:
+        return
+    for i, f in enumerate(st.plan.faults):
+        if f.kind != "journal_torn" or i in st._consumed:
+            continue
+        if f.at is not None and f.at != index:
+            continue
+        st._fire(i, f)
+        tear_file(path, record_start + f.offset, f.nbytes, f.tear)
         return
 
 

@@ -80,7 +80,9 @@ class FailureEvent:
     #: "exception" (executor raised) | "nonfinite" | "conservation"
     #: | "timeout" (a dispatch overran its deadline) | "expired" (a
     #: queued ticket's per-ticket deadline passed before dispatch —
-    #: the ISSUE 9 serving path; never a silent drop)
+    #: the ISSUE 9 serving path; never a silent drop) | "member" (a
+    #: fleet member was fenced — dead pump, supervision-deadline wedge
+    #: or ladder bottom — and restarted fresh, ISSUE 10)
     kind: str
     detail: str
     #: step rolled back to (== step of the last good checkpoint)
@@ -98,6 +100,9 @@ class FailureEvent:
     #: the scheduler ticket this event quarantined (None for supervisor
     #: events — tickets are a serving-layer concept)
     ticket: Optional[int] = None
+    #: the serving member that emitted this event (ISSUE 10: fleet-level
+    #: logs must be attributable per member); None outside serving
+    service_id: Optional[str] = None
 
 
 @dataclasses.dataclass

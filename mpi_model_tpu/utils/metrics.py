@@ -244,7 +244,8 @@ class ThroughputCounter:
     #: loudly, not silently count into a new attribute nothing reads
     COUNTERS = ("dispatches", "scenarios", "lanes", "cache_hits",
                 "solo_retries", "recovered_failures", "quarantined",
-                "impl_faults", "shed", "expired", "loop_faults")
+                "impl_faults", "shed", "expired", "loop_faults",
+                "member_faults", "readmitted", "scale_ups", "scale_downs")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -271,6 +272,15 @@ class ThroughputCounter:
         #: dispatch-loop iterations that raised and were supervised
         #: (the loop stays alive; the fault is counted, never silent)
         self.loop_faults = 0
+        #: fleet members fenced (dead pump / wedge / ladder bottom) —
+        #: each carries a kind="member" FailureEvent (ISSUE 10)
+        self.member_faults = 0
+        #: tickets re-admitted to a healthy member after their member
+        #: was fenced or a crash-restart recovery replayed the journal
+        self.readmitted = 0
+        #: autoscaling actions (fleet supervisor)
+        self.scale_ups = 0
+        self.scale_downs = 0
         self._latencies: collections.deque = collections.deque(
             maxlen=LATENCY_RESERVOIR)
 
@@ -339,6 +349,10 @@ class ThroughputCounter:
                 "shed": self.shed,
                 "expired": self.expired,
                 "loop_faults": self.loop_faults,
+                "member_faults": self.member_faults,
+                "readmitted": self.readmitted,
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
                 "latency_n": len(lat),
                 "latency_p50_s": (self._percentile(lat, 0.50)
                                   if lat else None),

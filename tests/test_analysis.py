@@ -639,3 +639,88 @@ def test_unguarded_shared_mutation_pragma_escape():
     out = lint_source(src, PKG)
     assert rules_of(out) == []
     assert any(f.suppressed for f in out)
+
+
+def test_unguarded_shared_mutation_lock_bound_outside_init():
+    """ISSUE 10 extension: a class that binds (or replaces) its lock in
+    a non-__init__ method is still lock-owning — the fleet supervisor's
+    late-bound per-generation state made this a real shape."""
+    src = (_THREADED_HDR +
+           "class Fleet:\n"
+           "    def _setup(self):\n"
+           "        self._lock = threading.RLock()\n"
+           "        self.members = {}\n"
+           "    def fence(self):\n"
+           "        self.members = {}\n")
+    out = lint_source(src, PKG)
+    # everything in _setup is an unguarded write (the lock binding
+    # itself included — it is not __init__), and fence writes unguarded
+    assert rules_of(out) == ["unguarded-shared-mutation"] * 3
+    # guarded + *_locked escapes still apply to late-bound locks
+    src2 = (_THREADED_HDR +
+            "class Fleet:\n"
+            "    def _setup_locked(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self.members = {}\n"
+            "    def fence(self):\n"
+            "        with self._lock:\n"
+            "            self.members = {}\n")
+    assert rules_of(lint_source(src2, PKG)) == []
+
+
+# -- wall-clock-in-test (ISSUE 10: zero-wall-sleeps, fleet-wide) --------------
+
+def test_wall_clock_in_test_positive():
+    src = ("import time\n"
+           "def test_x():\n"
+           "    time.sleep(0.1)\n"
+           "    assert time.time() > 0\n")
+    assert rules_of(lint_source(src, "tests/test_fake.py")) == (
+        ["wall-clock-in-test"] * 2)
+    # from-imports (aliased or not) are the same wall dependence
+    src2 = ("from time import sleep, time as now\n"
+            "def test_x():\n"
+            "    sleep(0.1)\n"
+            "    now()\n")
+    assert rules_of(lint_source(src2, "tests/test_fake.py")) == (
+        ["wall-clock-in-test"] * 2)
+
+
+def test_wall_clock_in_test_negative():
+    # the injectable-clock idiom and coarse duration bounds are legal
+    src = ("import time\n"
+           "def test_x():\n"
+           "    clock = {'t': 0.0}\n"
+           "    def fake_sleep(dt):\n"
+           "        clock['t'] += dt\n"
+           "    fake_sleep(1.0)\n"
+           "    t0 = time.perf_counter()\n"
+           "    t1 = time.monotonic()\n"
+           "    assert t1 >= 0 and t0 >= 0\n")
+    assert rules_of(lint_source(src, "tests/test_fake.py")) == []
+    # tests-only scope: the serving package USES time.sleep legally
+    src2 = ("import time\n"
+            "def run(dt):\n"
+            "    time.sleep(dt)\n")
+    assert rules_of(lint_source(src2, PKG)) == []
+
+
+def test_wall_clock_in_test_pragma_escape():
+    src = ("import time\n"
+           "def test_x():\n"
+           "    time.sleep(0.01)  # analysis: ignore[wall-clock-in-test]"
+           " — measures a real OS timer\n")
+    out = lint_source(src, "tests/test_fake.py")
+    assert rules_of(out) == []
+    assert any(f.suppressed for f in out)
+
+
+def test_wall_clock_in_test_catches_module_alias():
+    """`import time as _t; _t.sleep(...)` is the same wall dependence
+    and must not evade the rule."""
+    src = ("import time as _t\n"
+           "def test_x():\n"
+           "    _t.sleep(0.1)\n"
+           "    _t.monotonic()\n")  # monotonic stays legal, aliased too
+    assert rules_of(lint_source(src, "tests/test_fake.py")) == (
+        ["wall-clock-in-test"])

@@ -9,6 +9,7 @@ All faults here are in-memory / on-local-disk (no subprocesses), so the
 matrix runs inside the tier-1 inner loop as the chaos smoke."""
 
 import json
+import warnings
 import os
 
 import jax
@@ -936,3 +937,158 @@ def test_check_health_single_device_get(monkeypatch):
     init = {k: float(three.total(k)) for k in three.values}
     assert check_health(three, init, threshold=1e-6) == []
     assert calls["n"] == 1
+
+
+# -- the matrix with the FLEET armed (ISSUE 10) -------------------------------
+# Every async-serving fault kind through a 2-member FleetSupervisor:
+# whatever chaos does to one member, every fleet ticket still resolves
+# to a counted outcome and the supervisor state reconciles. The member
+# seams (member_kill / member_wedge) and the journal seam get their own
+# rows below; the deep per-kind semantics stay pinned by the dedicated
+# async rows above and tests/test_fleet.py.
+
+def _fleet(**kw):
+    from mpi_model_tpu.ensemble import FleetSupervisor
+
+    kw.setdefault("services", 2)
+    kw.setdefault("steps", 4)
+    kw.setdefault("retry", "solo")
+    return FleetSupervisor(make_model(4.0), start=False, **kw)
+
+
+FLEET_MATRIX = {
+    "lane_nan_transient": (
+        (Fault("lane_nan", lane=0, at=0, once=True),), {},
+        dict(min_recovered=1, quarantined=0)),
+    "lane_nan_sticky": (
+        (Fault("lane_nan", lane=0, once=False),), {},
+        dict(min_quarantined=1)),
+    "batch_exc": (
+        (Fault("batch_exc", at=0),), {},
+        dict(min_recovered=1, quarantined=0)),
+    "hang": (
+        (Fault("hang", at=0, seconds=5.0),),
+        dict(dispatch_deadline_s=1.0, clock=None),
+        dict(min_recovered=1, quarantined=0)),
+    "thread_exc": (
+        (Fault("thread_exc", at=0),), {},
+        dict(min_loop_faults=1, quarantined=0)),
+    "slow_compile": (
+        (Fault("slow_compile", at=0, seconds=5.0),),
+        dict(dispatch_deadline_s=1.0, clock=None),
+        dict(min_recovered=1, quarantined=0)),
+    "fetch_nan": (
+        (Fault("fetch_nan", at=0, lane=0, once=True),), {},
+        dict(min_recovered=1, quarantined=0)),
+    "queue_full": (
+        (Fault("queue_full", at=0),), {},
+        dict(quarantined=0, fleet_shed=0)),
+}
+
+
+@pytest.mark.parametrize("kind", sorted(FLEET_MATRIX))
+def test_fleet_matrix_every_ticket_resolves(kind):
+    faults, extra, expect = FLEET_MATRIX[kind]
+    extra = dict(extra)
+    if "clock" in extra:  # injectable clock rows (deadline semantics)
+        clock = {"t": 0.0}
+        extra["clock"] = lambda: clock["t"]
+    fleet = _fleet(**extra)
+    served = failed = 0
+    with inject.armed(FaultPlan(faults)) as st, warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        tickets = [fleet.submit(_scen_space(i)) for i in range(4)]
+        for t in tickets:
+            try:
+                fleet.result(t)
+                served += 1
+            # analysis: ignore[broad-except] — the matrix LEDGER: every
+            # non-served outcome must be counted, whatever chaos threw
+            # (per-kind semantics are pinned by the dedicated rows)
+            except Exception:
+                failed += 1
+    assert st.fired, f"{kind}: fault never fired"
+    assert served + failed == 4          # zero silent drops
+    stats = fleet.stats()
+    assert stats["pending"] == 0
+    if "quarantined" in expect:
+        assert stats["quarantined"] == expect["quarantined"]
+    if "min_quarantined" in expect:
+        assert stats["quarantined"] >= expect["min_quarantined"]
+    if "min_recovered" in expect:
+        assert stats["recovered_failures"] >= expect["min_recovered"]
+    if "min_loop_faults" in expect:
+        assert stats["loop_faults"] >= expect["min_loop_faults"]
+    if "fleet_shed" in expect:
+        assert stats["shed"] == expect["fleet_shed"]
+    fleet.stop()
+
+
+def test_fleet_matrix_member_kill_then_wedge():
+    """The new member seams, matrix-style: a kill fences one member,
+    then a wedge fences the member holding the NEXT wave — the stream
+    keeps serving through BOTH fencings with a complete ledger and two
+    kind="member" events."""
+    clock = {"t": 0.0}
+    fleet = _fleet(supervision_deadline_s=1.0, clock=lambda: clock["t"])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        # wave 1: kill whichever member holds the queue
+        tickets = [fleet.submit(_scen_space(i)) for i in range(3)]
+        victim = next(s["service_id"]
+                      for s in fleet.stats()["services"]
+                      if s["pending"] > 0)
+        with inject.armed(FaultPlan(
+                (Fault("member_kill", channel=victim),))) as st1:
+            outs = [fleet.result(t) for t in tickets]
+        # wave 2: wedge whichever member holds the new queue
+        wave2 = [fleet.submit(_scen_space(i), steps=3) for i in range(3)]
+        wedged = next(s["service_id"]
+                      for s in fleet.stats()["services"]
+                      if s["pending"] > 0)
+        with inject.armed(FaultPlan(
+                (Fault("member_wedge", channel=wedged,
+                       once=False),))) as st2:
+            fleet.pump_once()          # wedge holds the queue
+            clock["t"] = 2.0
+            fleet.pump_once()          # sig settles at the new clock
+            clock["t"] = 4.0
+            fleet.pump_once()          # deadline crossed → fence
+            outs2 = [fleet.result(t) for t in wave2]
+    assert {f["kind"] for f in st1.fired} == {"member_kill"}
+    assert "member_wedge" in {f["kind"] for f in st2.fired}
+    assert len(outs) == 3 and len(outs2) == 3
+    stats = fleet.stats()
+    assert stats["member_faults"] == 2 and stats["pending"] == 0
+    assert [e.kind for e in fleet.member_log] == ["member", "member"]
+    assert {e.service_id for e in fleet.member_log} == {victim, wedged}
+    fleet.stop()
+
+
+def test_fleet_matrix_journal_torn_recovery(tmp_path):
+    """journal_torn through the fleet: the torn suffix is lost, the
+    verified prefix recovers — tickets whose submits survived resolve
+    after the restart, and the replay audit reports the tear."""
+    from mpi_model_tpu.ensemble import FleetSupervisor
+    from mpi_model_tpu.ensemble.journal import journal_path, replay
+
+    fleet = _fleet(journal_dir=str(tmp_path), max_wait_s=1e9,
+                   max_batch=8)
+    t0 = fleet.submit(_scen_space(0))
+    # tear the journal mid-record as the SECOND submit is appended: its
+    # record is the torn suffix, t0's record is the verified prefix
+    plan = FaultPlan((Fault("journal_torn", at=1, offset=3,
+                            tear="truncate"),))
+    with inject.armed(plan) as st:
+        fleet.submit(_scen_space(1))
+    assert [f["kind"] for f in st.fired] == ["journal_torn"]
+    fleet.abandon()                    # crash before anything served
+    state = replay(journal_path(str(tmp_path)))
+    assert state.torn is True
+    assert list(state.submits) == [t0]
+    f2 = FleetSupervisor.recover(str(tmp_path), make_model(4.0),
+                                 services=2, steps=4, start=False)
+    assert f2.result(t0) is not None   # the verified prefix recovers
+    f2.stop()
+    state2 = replay(journal_path(str(tmp_path)))
+    assert state2.unresolved() == [] and not state2.duplicate_terminals
