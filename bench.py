@@ -1173,6 +1173,86 @@ def bench_checkpoint(grid: int = 16384, fracs: tuple = (0.01, 0.05),
     }
 
 
+def bench_ir(grid: int = 1024, steps: int = 16,
+             dtype_name: str = "float32", model_name: str = "gray_scott",
+             trials: int = 5, verbose: bool = False) -> dict:
+    """Flow IR throughput rows (ISSUE 11): Gray-Scott (by default)
+    through each ELIGIBLE step impl — the dense lowering ('xla'), the
+    composed path (nonlinear terms force k=1: the row exists precisely
+    to show that degeneration costs nothing), and the generic active
+    engine (term-derived predicate; Gray-Scott's u-background keeps it
+    on the dense fallback, which the row reports honestly via the
+    impl's own semantics). Median-of-``trials`` marginal estimates +
+    spread, cell-updates/s as the ladder's common unit.
+
+    GATE before any timing, at the timed geometry: the run must pass
+    per-term budget reconciliation (``FlowIRModel._raise_if_violated``
+    — declared source/sink budgets integrate and reconcile against the
+    observed mass drift, or the bench aborts naming the term)."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_model_tpu.ir import build_model
+    from mpi_model_tpu.models.model import SerialExecutor
+    from mpi_model_tpu.utils import marginal_runner_trials, positive_spread
+
+    enable_compile_cache()
+    dtype = jnp.dtype(dtype_name)
+    model, space = build_model(model_name, grid, dtype=dtype)
+    cells = float(grid) * grid * steps
+
+    # the budget gate: one checked run at the timed geometry — raises
+    # ConservationError naming the violating term on any breach
+    out, rep = model.execute(space, SerialExecutor(), steps=steps,
+                             check_conservation=True)
+    budgets = model.budget_totals(out)
+    if verbose:
+        print(f"  ir budget gate OK ({model_name} {grid}^2 "
+              f"{dtype_name}): residual "
+              f"{model.report_conservation_error(rep):.3e}, "
+              f"budgets {budgets}", file=sys.stderr)
+
+    rows = {}
+    for impl in ("xla", "composed", "active"):
+        ex = SerialExecutor(step_impl=impl)
+
+        def run(n: int, _ex=ex) -> None:
+            for _ in range(n):
+                vals = _ex.run_model(model, space, steps)
+                jax.block_until_ready(vals)
+
+        run(1)  # warm/compile
+        samples = marginal_runner_trials(run, s1=1, s2=3, trials=trials)
+        med = statistics.median(samples)
+        sp = positive_spread(samples, cells)
+        rows[impl] = {
+            "impl": impl, "wall_s": med,
+            "cups": cells / med if med > 0 else None,
+            "cups_spread": [sp["lo"], sp["hi"]],
+        }
+        if verbose:
+            print(f"  ir {model_name} {impl}: "
+                  f"{rows[impl]['cups'] or float('nan'):.3e} cup/s",
+                  file=sys.stderr)
+
+    return {
+        "metric": f"ir {model_name} cell-updates/s ({grid}^2 "
+                  f"{dtype_name}, {steps} steps, median of {trials})",
+        "model": model_name, "grid": grid, "steps": steps,
+        "dtype": dtype_name, "trials": trials,
+        "terms": [t.name for t in model.ir_terms],
+        "budget_gate": "passed",
+        "budgets": budgets,
+        "budget_residual": model.report_conservation_error(rep),
+        "impls": rows,
+        "cups": rows["xla"]["cups"],
+        "device_kind": getattr(jax.devices()[0], "device_kind", None),
+        "backend": jax.default_backend(),
+    }
+
+
 def bench_halo_mode(space, model, dense_step, substeps: int,
                     trials: int = 3, verbose: bool = False) -> dict:
     """Time the full sharded architecture on a 1-device TPU mesh: the
@@ -1371,6 +1451,13 @@ if __name__ == "__main__":
             # unreachable, and wants x64 for the bitwise-at-f64 gate
             os.environ.setdefault("JAX_ENABLE_X64", "true")
             result = bench_active(verbose="-v" in sys.argv)
+        elif "--ir" in sys.argv:
+            # the Flow IR rows (ISSUE 11): Gray-Scott per eligible impl
+            # with the per-term budget gate at the timed geometry
+            result = bench_ir(verbose="-v" in sys.argv)
+            with open("BENCH_IR_r01.json", "w") as fh:
+                json.dump(result, fh, indent=2)
+                fh.write("\n")
         elif "--checkpoint" in sys.argv:
             # the checkpoint-cost rows stand alone too: disk + host
             # work, no chip required (the active executor steps the

@@ -848,8 +848,29 @@ def config10(quick: bool = False) -> dict:
             **row}
 
 
+def config11(quick: bool = False) -> dict:
+    """Flow IR rows (ISSUE 11): Gray-Scott reaction-diffusion — two
+    coupled channels, a cubic transfer, declared feed/kill budgets —
+    through every eligible step impl (dense lowering / composed-at-k=1
+    / generic active), cell-updates/s median+spread per impl. The
+    per-term budget gate runs at the timed geometry before any timing:
+    the row aborts (naming the term) if the integrated source/sink
+    budgets fail to reconcile with the observed mass drift."""
+    import bench as bench_mod
+
+    g = 128 if quick else 1024
+    row = bench_mod.bench_ir(
+        grid=g, steps=4 if quick else 16,
+        trials=1 if quick else 3)
+    return {"config": 11, "flow": "gray-scott (IR terms)",
+            "strategy": "Flow IR lowering per eligible impl "
+                        "(budget-gated)",
+            **row}
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8, 9: config9, 10: config10}
+           6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
+           11: config11}
 
 
 def sweep_blocks(grid: int = 8192, dtype_name: str = "bfloat16") -> list:

@@ -38,6 +38,16 @@ from .ensemble import (
     ServiceOverloaded,
     TicketExpired,
 )
+from .ir import (
+    Chan,
+    Clock,
+    FlowIRModel,
+    Sink,
+    Source,
+    Transfer,
+    Transport,
+    build_model,
+)
 
 __version__ = "0.1.0"
 
@@ -69,5 +79,13 @@ __all__ = [
     "ServiceOverloaded",
     "TicketExpired",
     "EnsembleSpace",
+    "Chan",
+    "Clock",
+    "FlowIRModel",
+    "Sink",
+    "Source",
+    "Transfer",
+    "Transport",
+    "build_model",
     "__version__",
 ]

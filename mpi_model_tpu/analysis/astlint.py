@@ -908,3 +908,50 @@ def run_astlint(roots, rules: Optional[Iterable[str]] = None,
         for p in iter_py_files(root):
             findings.extend(lint_file(p, rules, rel_to=rel_to))
     return findings
+
+
+# -- hardcoded-physics rule (ISSUE 11 satellite) ------------------------------
+# The Flow IR exists so new physics is TERMS + one registered lowering,
+# not four hand-mirrored step functions. This rule is the structural
+# backstop: transport-shaped arithmetic (the stencil redistribution
+# helpers) appearing in package code OUTSIDE the ops/ kernels and the
+# ir/ lowering reads as a fifth hand-written step growing back. The
+# pre-IR call sites that legitimately remain (the legacy flow paths the
+# IR cannot represent exactly) carry pragmas with their reasons — new
+# ones must either live in ir/lowerings or justify themselves the same
+# way.
+
+#: the transport-shaped helper surface: calling any of these builds a
+#: stencil redistribution step (or a piece of one)
+_PHYSICS_HELPERS = {"transport", "flow_step", "point_flow_step",
+                    "gather_neighbors", "gather_from_padded", "shift2d",
+                    "weighted_counts_traced"}
+
+
+def _physics_boundary_module(ctx: ModuleCtx) -> bool:
+    """ops/ (the kernel layer) and ir/ (the registered lowerings) are
+    where transport arithmetic lives by design."""
+    parts = ctx.resolved_parts
+    return "ops" in parts or "ir" in parts
+
+
+@rule("hardcoded-physics", Severity.WARNING,
+      "transport-shaped arithmetic (stencil redistribution helpers) "
+      "outside ops/ and ir/ lowerings — new physics belongs in IR "
+      "terms lowered once, not in another hand-mirrored step",
+      scope=SCOPE_PACKAGE)
+def check_hardcoded_physics(ctx: ModuleCtx):
+    if _physics_boundary_module(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted_last(node.func)
+        if name in _PHYSICS_HELPERS:
+            yield Finding(
+                "hardcoded-physics", Severity.WARNING, ctx.path,
+                node.lineno,
+                f"`{name}(...)` outside ops/ and ir/: transport-shaped "
+                "arithmetic belongs in an IR term's registered lowering "
+                "(ir.lower) so every engine serves it — pragma a "
+                "retained legacy path with its reason")

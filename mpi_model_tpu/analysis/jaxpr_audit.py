@@ -81,6 +81,10 @@ _register("jaxpr-consts",
 _register("jaxpr-halo",
           "stencil radius must fit the halo depth the impl's sharded "
           "configuration declares")
+_register("jaxpr-term-registry",
+          "every Flow IR term kind has exactly one registered, audited "
+          "lowering, and it lives in ir.lower — no impl-private term "
+          "branches")
 _register("jaxpr-fused-flags",
           "the fused active runner's per-pass loop must carry no "
           "reduction at tile size or larger outside the kernel — "
@@ -246,6 +250,87 @@ def _build_active_fused_runner() -> BuiltStep:
                      substeps=k, dtype_check=False,
                      expect_prefetch_arg=True,
                      fused_flags_tile_elems=plan.tile[0] * plan.tile[1])
+
+
+def _ir_contract(model_name: str, impl: str, grid: int = 32):
+    """One Flow IR lowering golden: trace ``FlowIRModel.make_step`` for
+    a registered library model under one eligible impl (the per-term
+    lowering goldens satellite — ISSUE 11). The audited jaxpr is the
+    SAME registered lowering every engine consumes, so dtype/callback/
+    const/halo violations in any term's lowering surface here once."""
+    def build() -> BuiltStep:
+        import jax
+        from ..ir import library
+        from ..ir.model import FlowIRModel
+        model, space = library.build_model(model_name, grid,
+                                           dtype="float64")
+        if impl == "active":
+            # a sub-grid tile plan so the WINDOW machinery (not the
+            # one-tile dense degeneration) is what gets audited
+            model = FlowIRModel(model.ir_terms, model.time,
+                                model.time_step,
+                                active_opts={"tile": (grid // 4,
+                                                      grid // 4)})
+        step = model.make_step(space, impl=impl)
+        args = {k: _sds(v) for k, v in space.values.items()}
+        v0 = next(iter(space.values.values()))
+        return BuiltStep(f"ir_{model_name}_{impl}", step, (args,),
+                         space.dtype, v0.dtype.itemsize * v0.size,
+                         model.offsets, 1)
+    return build
+
+
+for _m in ("gray_scott", "sir", "predator_prey"):
+    for _i in ("xla", "composed", "active"):
+        CONTRACTS[f"ir_{_m}_{_i}"] = _ir_contract(_m, _i)
+CONTRACTS["ir_diffusion_xla"] = _ir_contract("diffusion", "xla")
+
+
+def check_term_registry() -> list[Finding]:
+    """The ``jaxpr-term-registry`` rule: walk every Term subclass the
+    package defines (transitively) and assert the ir.lower registry
+    holds exactly one lowering for each, defined IN ir.lower. A term
+    kind lowered elsewhere — an impl-private branch — is exactly the
+    hand-mirroring the IR exists to end."""
+    from ..ir import lower as ir_lower
+    from ..ir.terms import Term
+
+    findings: list[Finding] = []
+
+    def subclasses(cls):
+        for sub in cls.__subclasses__():
+            yield sub
+            yield from subclasses(sub)
+
+    for kind in subclasses(Term):
+        low = ir_lower.LOWERINGS.get(kind)
+        inherited = None
+        if low is None:
+            # a subclass may legitimately inherit its base kind's
+            # registered lowering (same apply contract); only a kind
+            # with NO lowering anywhere in its MRO is unregistered
+            for base in kind.__mro__[1:]:
+                if base in ir_lower.LOWERINGS:
+                    inherited = ir_lower.LOWERINGS[base]
+                    break
+            if inherited is None:
+                findings.append(Finding(
+                    "jaxpr-term-registry", Severity.ERROR,
+                    "jaxpr:term-registry", 0,
+                    f"term kind {kind.__name__} has no registered "
+                    "lowering — register exactly one in ir.lower"))
+                continue
+        target = low if low is not None else inherited
+        mod = getattr(target, "__module__", "")
+        if mod != ir_lower.__name__:
+            findings.append(Finding(
+                "jaxpr-term-registry", Severity.ERROR,
+                "jaxpr:term-registry", 0,
+                f"term kind {kind.__name__}'s lowering {target!r} is "
+                f"defined in {mod!r}, not ir.lower — impl-private term "
+                "lowerings reintroduce the hand-mirroring the IR "
+                "replaces"))
+    return findings
 
 
 # -- jaxpr walks --------------------------------------------------------------
@@ -484,6 +569,8 @@ def run_jaxpr_audit(impls=None) -> list[Finding]:
     jax.config.update("jax_enable_x64", True)
     jax.config.update("jax_default_device", "cpu")
     findings: list[Finding] = []
+    if impls is None or "term-registry" in impls:
+        findings.extend(check_term_registry())
     try:
         for name, build in CONTRACTS.items():
             if impls is not None and name not in impls:

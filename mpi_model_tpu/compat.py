@@ -104,3 +104,27 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma: Optional[bool] = None)
     # this branch isn't taken, handles loops fine)
     return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                check_rep=bool(check_vma) if check_vma is not None else False)
+
+
+def optimization_barrier(x):
+    """``lax.optimization_barrier`` that also works under ``vmap``: jax
+    0.4.x never registered a batching rule for the primitive (it landed
+    upstream later), and the IR lowering's pointwise amounts run both
+    serially and inside the ensemble's vmapped parametric step. The
+    rule is the identity passthrough (a barrier commutes with
+    batching); registered once, lazily, and only when missing — on a
+    jax that already has the rule this is exactly ``lax
+    .optimization_barrier``."""
+    from jax import lax
+    from jax.interpreters import batching
+
+    p = getattr(lax, "optimization_barrier_p", None)
+    if p is None:  # pragma: no cover - very old jax spelling
+        from jax._src.lax import lax as _ll
+        p = _ll.optimization_barrier_p
+    if p not in batching.primitive_batchers:
+        def _batch_rule(args, dims, **params):
+            return p.bind(*args, **params), list(dims)
+
+        batching.primitive_batchers[p] = _batch_rule
+    return lax.optimization_barrier(x)

@@ -1,0 +1,13 @@
+"""Flow IR (ISSUE 11): a declarative term language for nonlinear,
+coupled physics, lowered by ONE registered lowering to every step
+engine. See ``ir.terms`` (the grammar), ``ir.lower`` (the lowering +
+engine contexts), ``ir.model`` (FlowIRModel: budgets, conservation
+reconciliation), ``ir.library`` (the built-in model registry behind
+``--model``)."""
+
+from .expr import (Chan, Const, Expr, abs_, exp, maximum,  # noqa: F401
+                   minimum)
+from .library import MODELS, build_model  # noqa: F401
+from .model import FlowIRModel  # noqa: F401
+from .terms import (BUDGET_PREFIX, Clock, Sink, Source, Term,  # noqa: F401
+                    Transfer, Transport)

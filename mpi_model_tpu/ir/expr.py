@@ -1,0 +1,231 @@
+"""The Flow IR's pointwise expression mini-language (ISSUE 11).
+
+A term's *amount* — what a reaction transfers, a source injects, a sink
+drains — is a tiny declarative expression tree over a **whitelisted
+primitive set**: channel reads, constants, and the arithmetic below.
+Nothing else exists in the grammar, so a model cannot smuggle host
+callbacks, reductions, data-dependent shapes or un-shardable reads into
+a step: every expression is pointwise by construction (a cell's value
+depends only on that cell's own channel values), which is what lets ONE
+registered lowering (``ir.lower``) serve the dense, composed, active
+and sharded engines from the same tree.
+
+Grammar::
+
+    expr := Const(float) | Chan(name)
+          | expr + expr | expr - expr | expr * expr | expr / expr
+          | -expr | expr ** k (integer k >= 1)
+          | exp(expr) | abs_(expr) | minimum(a, b) | maximum(a, b)
+
+Python operators are overloaded on ``Expr``, so model code reads like
+the math: ``Chan("u") * Chan("v") ** 2`` is the Gray-Scott reaction
+amount. Numeric parameters that vary PER SCENARIO do not live here —
+each term carries exactly one ``rate`` scalar that multiplies its
+amount and rides the ensemble's traced ``[B, F]`` parameter lanes
+(``ir.terms``); everything inside the expression is structural.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+#: the whitelisted primitive set: op name -> arity. ``evaluate`` refuses
+#: anything else by construction (there is no node type to carry it),
+#: and defensively by name (a hand-built node with an unknown op raises
+#: naming the op, never silently evaluates).
+PRIMITIVES = {
+    "add": 2, "sub": 2, "mul": 2, "div": 2,
+    "min": 2, "max": 2,
+    "neg": 1, "exp": 1, "abs": 1,
+}
+
+
+class Expr:
+    """Base node; operator overloads build trees out of the whitelist."""
+
+    def __add__(self, o): return Binary("add", self, as_expr(o))
+    def __radd__(self, o): return Binary("add", as_expr(o), self)
+    def __sub__(self, o): return Binary("sub", self, as_expr(o))
+    def __rsub__(self, o): return Binary("sub", as_expr(o), self)
+    def __mul__(self, o): return Binary("mul", self, as_expr(o))
+    def __rmul__(self, o): return Binary("mul", as_expr(o), self)
+    def __truediv__(self, o): return Binary("div", self, as_expr(o))
+    def __rtruediv__(self, o): return Binary("div", as_expr(o), self)
+    def __neg__(self): return Unary("neg", self)
+
+    def __pow__(self, k):
+        if not isinstance(k, int) or k < 1:
+            raise TypeError(
+                f"Expr ** k needs an integer exponent >= 1, got {k!r} "
+                "(the whitelist has no general pow — square/cube by "
+                "repeated multiplication)")
+        return Power(self, k)
+
+
+@dataclasses.dataclass(frozen=True)
+class Const(Expr):
+    """A structural numeric constant (baked into the compiled step; a
+    per-scenario number belongs in the owning term's ``rate``)."""
+
+    value: float
+
+    def __post_init__(self):
+        object.__setattr__(self, "value", float(self.value))
+
+
+@dataclasses.dataclass(frozen=True)
+class Chan(Expr):
+    """Read of one attribute channel at the cell itself (pointwise)."""
+
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Unary(Expr):
+    op: str
+    a: Expr
+
+
+@dataclasses.dataclass(frozen=True)
+class Binary(Expr):
+    op: str
+    a: Expr
+    b: Expr
+
+
+@dataclasses.dataclass(frozen=True)
+class Power(Expr):
+    """Integer power, lowered as repeated multiplication (deterministic
+    op sequence — the cross-impl bitwise gates depend on it)."""
+
+    a: Expr
+    n: int
+
+
+def as_expr(x: Union[Expr, float, int]) -> Expr:
+    if isinstance(x, Expr):
+        return x
+    if isinstance(x, (int, float)):
+        return Const(float(x))
+    raise TypeError(f"cannot use {type(x).__name__} in an IR expression "
+                    "(whitelist: Expr nodes and numbers)")
+
+
+def exp(a) -> Expr:
+    return Unary("exp", as_expr(a))
+
+
+def abs_(a) -> Expr:
+    return Unary("abs", as_expr(a))
+
+
+def minimum(a, b) -> Expr:
+    return Binary("min", as_expr(a), as_expr(b))
+
+
+def maximum(a, b) -> Expr:
+    return Binary("max", as_expr(a), as_expr(b))
+
+
+_UNARY_FNS = {"neg": lambda x: -x, "exp": jnp.exp, "abs": jnp.abs}
+_BINARY_FNS = {
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "div": lambda a, b: a / b,
+    "min": jnp.minimum,
+    "max": jnp.maximum,
+}
+
+
+def evaluate(e: Expr, env: dict[str, jax.Array], dtype) -> jax.Array:
+    """Evaluate ``e`` against channel arrays ``env``; constants are cast
+    to ``dtype`` (the space's flow dtype) so the tree's arithmetic never
+    silently promotes. Every engine's context calls THIS function — the
+    one evaluator is part of the single-lowering contract."""
+    if isinstance(e, Const):
+        return jnp.asarray(e.value, dtype)
+    if isinstance(e, Chan):
+        v = env.get(e.name)
+        if v is None:
+            raise KeyError(
+                f"expression reads channel {e.name!r} which the space "
+                f"does not carry (has {tuple(env)})")
+        return v
+    if isinstance(e, Power):
+        base = evaluate(e.a, env, dtype)
+        acc = base
+        for _ in range(e.n - 1):
+            acc = acc * base
+        return acc
+    if isinstance(e, Unary):
+        fn = _UNARY_FNS.get(e.op)
+        if fn is None or e.op not in PRIMITIVES:
+            raise ValueError(f"unknown/unwhitelisted unary op {e.op!r}")
+        return fn(evaluate(e.a, env, dtype))
+    if isinstance(e, Binary):
+        fn = _BINARY_FNS.get(e.op)
+        if fn is None or e.op not in PRIMITIVES:
+            raise ValueError(f"unknown/unwhitelisted binary op {e.op!r}")
+        return fn(evaluate(e.a, env, dtype), evaluate(e.b, env, dtype))
+    raise TypeError(f"not an IR expression node: {type(e).__name__}")
+
+
+def channels(e: Expr) -> frozenset[str]:
+    """The set of channels the expression reads."""
+    if isinstance(e, Chan):
+        return frozenset((e.name,))
+    if isinstance(e, Const):
+        return frozenset()
+    if isinstance(e, (Unary, Power)):
+        return channels(e.a)
+    if isinstance(e, Binary):
+        return channels(e.a) | channels(e.b)
+    raise TypeError(f"not an IR expression node: {type(e).__name__}")
+
+
+def fingerprint(e: Expr) -> tuple:
+    """Hashable structural identity (constants INCLUDED — they are baked
+    into the compiled step, so differing constants are different
+    programs; only the per-term ``rate`` is a traced parameter)."""
+    if isinstance(e, Const):
+        return ("const", e.value)
+    if isinstance(e, Chan):
+        return ("chan", e.name)
+    if isinstance(e, Power):
+        return ("pow", fingerprint(e.a), e.n)
+    if isinstance(e, Unary):
+        return (e.op, fingerprint(e.a))
+    if isinstance(e, Binary):
+        return (e.op, fingerprint(e.a), fingerprint(e.b))
+    raise TypeError(f"not an IR expression node: {type(e).__name__}")
+
+
+def zero_point(e: Expr) -> Optional[tuple[str, float]]:
+    """A ``(channel, ref)`` pair such that the expression is provably
+    zero wherever ``channel == ref`` — the symbolic root the active
+    engine derives a term's ACTIVITY PREDICATE from (a tile where every
+    term is provably zero can be skipped). Conservative: ``None`` means
+    "no such proof" and the term keeps every tile active.
+
+    Rules: ``Chan(c)`` is zero at ``c == 0``; a product is zero where
+    either factor is; powers/negation preserve roots; ``k - Chan(c)``
+    is zero at ``c == k``."""
+    if isinstance(e, Chan):
+        return (e.name, 0.0)
+    if isinstance(e, Power):
+        return zero_point(e.a)
+    if isinstance(e, Unary) and e.op == "neg":
+        return zero_point(e.a)
+    if isinstance(e, Binary) and e.op == "mul":
+        return zero_point(e.a) or zero_point(e.b)
+    if isinstance(e, Binary) and e.op == "sub":
+        if isinstance(e.a, Const) and isinstance(e.b, Chan):
+            return (e.b.name, e.a.value)
+        if isinstance(e.a, Chan) and isinstance(e.b, Const):
+            return (e.a.name, e.b.value)
+    return None

@@ -865,6 +865,28 @@ class Model:
         gshape = space.global_shape
         shape = (space.dim_x, space.dim_y)
 
+        # the dense XLA path's transport is owned by the Flow IR's ONE
+        # registered lowering (ISSUE 11): plain-Diffusion field flows
+        # convert to IR Transport terms and the step body delegates to
+        # ir.lower.dense_apply — the lowering the diffusion-as-IR gate
+        # proves bitwise, now the single source of truth. Flows the IR
+        # cannot represent exactly (user flows, several Diffusions on
+        # one attr, off-space-dtype channels) keep the summed-outflow
+        # legacy path.
+        dense_ir = None
+        dense_ir_meta = None
+        if field_flows and impl in ("xla", "auto"):
+            from ..ir.lower import StepMeta, diffusion_terms
+
+            terms = diffusion_terms(field_flows)
+            if terms is not None and all(
+                    space.values[t.channel].dtype == jnp.dtype(space.dtype)
+                    for t in terms):
+                dense_ir = terms
+                dense_ir_meta = StepMeta(
+                    shape=shape, origin=origin, global_shape=gshape,
+                    dtype=space.dtype, offsets=offsets)
+
         def single(values: Values) -> Values:
             new = dict(values)
             # counts as traced iota arithmetic INSIDE the step: closing
@@ -900,9 +922,19 @@ class Model:
                 # passes * k = substeps flow steps per channel
                 for attr, stepper in fused_steppers.items():
                     new[attr] = stepper(values[attr])
+            elif dense_ir is not None:
+                from ..ir.lower import dense_apply
+
+                new.update(dense_apply(
+                    dense_ir, values, [t.rate for t in dense_ir],
+                    dense_ir_meta, counts))
             else:
                 outflow = build_outflow(field_flows, values, origin)
                 for attr, o in outflow.items():
+                    # analysis: ignore[hardcoded-physics] — legacy FLOW
+                    # fallback for what the IR cannot represent exactly
+                    # (user flows, summed same-attr outflows); the
+                    # convertible dense path above runs the IR lowering
                     new[attr] = transport(values[attr], o, counts, offsets)
             # Point amounts read the PRE-step values (matches summed-outflow
             # semantics: transport is linear in outflow).
@@ -911,6 +943,9 @@ class Model:
                 xs = jnp.asarray([lx for lx, _, _ in locs])
                 ys = jnp.asarray([ly for _, ly, _ in locs])
                 amts = jnp.stack([f.amount(values, origin) for f in pflows])
+                # analysis: ignore[hardcoded-physics] — the point-source
+                # scatter (the reference's live workload) is outside the
+                # IR field-term grammar by design
                 new[attr] = point_flow_step(new[attr], xs, ys, amts, counts,
                                             offsets)
             return new
@@ -1031,14 +1066,24 @@ class Model:
             backend_report=getattr(executor, "last_backend_report", None),
         )
         if check_conservation and not space.is_partition:
-            thresh = self.conservation_threshold(space, tolerance, rtol,
-                                                 initial_totals=initial)
-            if report.conservation_error() > thresh:
-                raise ConservationError(
-                    f"mass conservation violated: |Δ| = "
-                    f"{report.conservation_error():.3e} > {thresh:.3e} "
-                    f"(initial={initial}, final={final})")
+            self._raise_if_violated(space, initial, final, tolerance, rtol)
         return out_space, report
+
+    def _raise_if_violated(self, space: CellularSpace, initial: dict,
+                           final: dict, tolerance: float,
+                           rtol: Optional[float]) -> None:
+        """The conservation gate, as an overridable seam: the classic
+        per-channel |Δtotal| contract here; ``ir.FlowIRModel`` replaces
+        it with per-term budget reconciliation (declared sources/sinks
+        integrated and reconciled, violations naming the term)."""
+        thresh = self.conservation_threshold(space, tolerance, rtol,
+                                             initial_totals=initial)
+        err = max(abs(final[k] - initial[k]) for k in initial)
+        if err > thresh:
+            raise ConservationError(
+                f"mass conservation violated: |Δ| = "
+                f"{err:.3e} > {thresh:.3e} "
+                f"(initial={initial}, final={final})")
 
     def execute_many(
         self,

@@ -87,6 +87,32 @@ def neighbor_counts_traced(
     return cnt
 
 
+def weighted_counts_traced(
+    shape: tuple[int, int],
+    offsets: Sequence[tuple[int, int]],
+    weights: Sequence[float],
+    origin: tuple[int, int] = (0, 0),
+    global_shape: tuple[int, int] = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Per-cell sum of the in-bounds taps' WEIGHTS — the divisor of the
+    weighted-tap Transport term (``ir.terms.Transport(weights=...)``);
+    with unit weights this is exactly ``neighbor_counts_traced``. Same
+    traced-iota discipline (no O(grid) constant baked into the step)."""
+    h, w = shape
+    gx, gy = global_shape if global_shape is not None else (h, w)
+    x0, y0 = origin
+    rows = x0 + jnp.arange(h, dtype=jnp.int32)[:, None]
+    cols = y0 + jnp.arange(w, dtype=jnp.int32)[None, :]
+    cnt = None
+    for wt, (dx, dy) in zip(weights, offsets):
+        ok = ((rows + dx >= 0) & (rows + dx < gx)
+              & (cols + dy >= 0) & (cols + dy < gy))
+        c = ok.astype(dtype) * jnp.asarray(wt, dtype)
+        cnt = c if cnt is None else cnt + c
+    return cnt
+
+
 def transport(values: jax.Array, outflow: jax.Array, counts: jax.Array,
               offsets: Sequence[tuple[int, int]] = MOORE_OFFSETS) -> jax.Array:
     """One mass-conserving redistribution step over the whole grid."""

@@ -339,6 +339,46 @@ class ShardMapExecutor:
 
         from ..utils.tracing import get_tracer
 
+        # nonlinear Flow IR models (ISSUE 11): the general registered
+        # lowering in its ghost-ring context — the model's max term
+        # FOOTPRINT drives the required halo depth (1 for the current
+        # grammar: transport reads the Moore ring), instead of trusting
+        # a hand-set knob. Linear IR models never reach this branch
+        # (their Diffusion flows view rides every specialized path
+        # below, bitwise).
+        if (getattr(model, "ir_terms", None) is not None
+                and not model.ir_linear):
+            if self.step_impl not in ("xla", "auto"):
+                raise ValueError(
+                    f"step_impl={self.step_impl!r} is a linear-stencil "
+                    "engine; this model's nonlinear IR terms "
+                    f"({[t.name for t in model.ir_terms]}) run the "
+                    "general lowering — use step_impl='xla'/'auto'.")
+            from ..ir.lower import max_footprint
+
+            need = max(1, max_footprint(model.ir_terms))
+            if self.halo_depth != need:
+                raise ValueError(
+                    f"this model's terms read a footprint-"
+                    f"{max_footprint(model.ir_terms)} stencil: the "
+                    f"required halo depth is {need}, got halo_depth="
+                    f"{self.halo_depth} (nonlinear terms do not compose "
+                    "into deep-halo chunks — the tap table is a linear "
+                    "object)")
+            # the flow-based key's fingerprint component is EMPTY for a
+            # nonlinear IR model (flows=[]), and the runner bakes the
+            # term rates concretely — the terms' own fingerprints must
+            # be part of the identity or two models sharing a geometry
+            # would silently share one compiled physics
+            ikey = ("ir", model._term_fingerprints()) + key
+            runner = self._cache.get(ikey)
+            if runner is None:
+                with get_tracer().span("shardmap.build", impl="ir"):
+                    runner = self._build_ir_runner(model, space)
+                self._cache[ikey] = runner
+            self.last_impl = "xla"
+            return runner(values, n)
+
         # all-FROZEN-point-flow models (the reference's live workload)
         # step only the ≤9k involved cells per shard — constant per-step
         # deltas mean NO halo traffic at all; owned entries scatter back
@@ -1040,6 +1080,88 @@ class ShardMapExecutor:
                             check_vma=False if fused else None)
         return jax.jit(sharded), plan, len(live), nx * ny
 
+    def _build_ir_runner(self, model, space: CellularSpace):
+        """Per-shard runner for nonlinear Flow IR models: one ppermute
+        VALUE exchange per step for the channels some ring-1 term reads
+        (the term footprints say which — budget/pointwise channels never
+        ship), then the SAME registered lowering the serial dense step
+        runs, in its ghost-padded context (``ir.lower.padded_apply``).
+        Value-exchange keeps the result bitwise equal to the serial
+        step: a ghost cell's outflow/share is recomputed here from the
+        same operands with the same expression the owning shard uses
+        (the ``ops.active`` discipline), and ghost cells beyond the
+        partition are masked to zero — the serial zero-pad semantics."""
+        from jax import lax
+
+        from ..ir.lower import StepMeta, involved_channels, padded_apply
+        from ..ops.stencil import neighbor_counts_traced
+
+        model._validate_space(space)
+        terms = model.ir_terms
+        rates = model.term_rates()
+        missing = sorted(involved_channels(terms) - set(space.values))
+        if missing:
+            raise ValueError(f"space is missing IR channels {missing}")
+        mesh = self.mesh
+        names, nx, ny, local_h, local_w = self._shard_geometry(space)
+        offsets = tuple(model.offsets)
+        gshape = space.global_shape
+        x_init, y_init = space.x_init, space.y_init
+        dtype = space.dtype
+        spec = grid_spec(mesh)
+        ring_chs = sorted(set().union(
+            *(t.reads() for t in terms if t.footprint >= 1)) or set())
+
+        if self.halo_mode == "zero":
+            def pad(z):  # diagnostic: no inter-shard traffic
+                return jnp.pad(z, 1)
+        elif len(names) == 1:
+            def pad(z):
+                return pad_with_halo_1d(z, names[0], nx)
+        else:
+            def pad(z):
+                return pad_with_halo_2d(z, names[0], names[1], nx, ny)
+
+        def shard_fn(values, n):
+            row0 = np.int32(x_init) + lax.axis_index(names[0]) * np.int32(
+                local_h)
+            col0 = (np.int32(y_init)
+                    + lax.axis_index(names[1]) * np.int32(local_w)
+                    if len(names) > 1 else jnp.int32(y_init))
+            meta = StepMeta(shape=(local_h, local_w), origin=(row0, col0),
+                            global_shape=gshape, dtype=dtype,
+                            offsets=offsets)
+            PH, PW = local_h + 2, local_w + 2
+            # partition-bounds mask over the padded shard (ghosts beyond
+            # the true grid/partition shed nothing — the serial
+            # zero-pad's bitwise twin) + global-true clamped counts
+            rowg = (row0 - np.int32(1)) + lax.broadcasted_iota(
+                jnp.int32, (PH, PW), 0)
+            colg = (col0 - np.int32(1)) + lax.broadcasted_iota(
+                jnp.int32, (PH, PW), 1)
+            mask_pb = ((rowg >= np.int32(x_init))
+                       & (rowg < np.int32(x_init) + np.int32(space.dim_x))
+                       & (colg >= np.int32(y_init))
+                       & (colg < np.int32(y_init) + np.int32(space.dim_y)))
+            counts_pad = jnp.maximum(
+                neighbor_counts_traced(
+                    (PH, PW), offsets,
+                    (row0 - np.int32(1), col0 - np.int32(1)), gshape,
+                    dtype),
+                jnp.asarray(1, dtype))
+
+            def body(i, c):
+                padded = {k: pad(c[k]) for k in ring_chs}
+                return padded_apply(terms, c, padded, rates, meta,
+                                    counts_pad, mask_pb)
+
+            # n is a TRACED scalar: one compile serves every step count
+            return lax.fori_loop(0, n, body, values)
+
+        sharded = shard_map(shard_fn, mesh=mesh, in_specs=(spec, P()),
+                            out_specs=spec)
+        return jax.jit(sharded)
+
     def _build_runner(self, model, space: CellularSpace):
         mesh = self.mesh
         names, nx, ny, local_h, local_w = self._shard_geometry(space)
@@ -1128,6 +1250,10 @@ class ShardMapExecutor:
             outflows = point_outflows(outflows, values, row0, col0)
             for attr, outflow in outflows.items():
                 share = outflow / counts
+                # analysis: ignore[hardcoded-physics] — the legacy
+                # share-exchanging flow shard step (general flows +
+                # point scatters); nonlinear IR models run
+                # _build_ir_runner's registered lowering instead
                 inflow = gather_from_padded(pad(share), offsets)
                 new[attr] = values[attr] - outflow + inflow
             return new

@@ -128,7 +128,9 @@ class SupervisedResult:
 
 def check_health(space: CellularSpace,
                  initial_totals: Optional[dict[str, float]] = None,
-                 threshold: Optional[float] = None) -> list[str]:
+                 threshold: Optional[float] = None,
+                 view: Optional[Callable[[dict], dict]] = None
+                 ) -> list[str]:
     """Detect bad simulation state; returns a list of problems (empty =
     healthy). Checks every attribute channel for non-finite values and —
     when ``initial_totals``/``threshold`` are given — total-mass drift
@@ -149,24 +151,56 @@ def check_health(space: CellularSpace,
         names.append(name)
         scalars.append((jnp.isfinite(arr).all(), jnp.sum(arr, dtype=acc)))
     fetched = jax.device_get(scalars)  # device work above, ONE sync here
+    totals: dict[str, float] = {}
+    any_nonfinite = False
     for name, (finite, total) in zip(names, fetched):
         if not bool(finite):
+            any_nonfinite = True
             problems.append(
                 f"channel {name!r}: non-finite cell(s) "
                 "(NaN/Inf divergence)")
             continue  # totals of a non-finite channel are meaningless
-        if initial_totals is not None and threshold is not None:
-            baseline = initial_totals.get(name)
-            if baseline is None:
-                # a channel added after the baseline was captured (e.g. a
-                # resumed run whose checkpoint predates it) has no drift
-                # reference — skip rather than KeyError mid-health-check
-                continue
-            drift = abs(float(total) - baseline)
-            if drift > threshold:
-                problems.append(
-                    f"channel {name!r}: conservation drift {drift:.3e} > "
-                    f"{threshold:.3e}")
+        totals[name] = float(total)
+    if initial_totals is None or threshold is None:
+        return problems
+    if view is not None:
+        # IR models (ISSUE 11): drift is judged on the conservation
+        # VIEW — summed mass reconciled against the integrated per-term
+        # budgets, plus the per-term contract keys — not on raw channel
+        # totals (a declared source's per-channel drift is physics).
+        # With any non-finite channel the view sums would be NaN; the
+        # nonfinite problem above already tells the truth there.
+        if not any_nonfinite:
+            try:
+                vi = view(initial_totals)
+                vt = view(totals)
+            except KeyError:
+                # a baseline captured before some view channel existed
+                # (e.g. a resume from a pre-IR checkpoint): no drift
+                # reference — same skip-don't-KeyError rule as the
+                # legacy per-channel branch below
+                return problems
+            for key in vi:
+                if key not in vt:
+                    continue
+                drift = abs(float(vt[key]) - float(vi[key]))
+                if drift > threshold:
+                    problems.append(
+                        f"channel {key!r}: conservation drift "
+                        f"{drift:.3e} > {threshold:.3e}")
+        return problems
+    for name, total in totals.items():
+        baseline = initial_totals.get(name)
+        if baseline is None:
+            # a channel added after the baseline was captured (e.g. a
+            # resumed run whose checkpoint predates it) has no drift
+            # reference — skip rather than KeyError mid-health-check
+            continue
+        drift = abs(total - baseline)
+        if drift > threshold:
+            problems.append(
+                f"channel {name!r}: conservation drift {drift:.3e} > "
+                f"{threshold:.3e}")
     return problems
 
 
@@ -354,7 +388,9 @@ def _supervise_loop(model, space, manager, total, every, max_failures,
                 out_space, report = model.execute(
                     good_space, executor, steps=n, check_conservation=False)
                 if health_checks:
-                    problems = check_health(out_space, initial, threshold)
+                    problems = check_health(
+                        out_space, initial, threshold,
+                        view=getattr(model, "conservation_view", None))
                     if problems:
                         raise HealthError(problems)
         # analysis: ignore[broad-except] — THE supervisor boundary: any

@@ -398,8 +398,12 @@ def test_stencil_radius():
 # -- jaxpr audit: goldens over the four registered impls ----------------------
 
 def test_contracts_cover_all_registered_impls():
+    # the Flow IR lowering goldens (ISSUE 11): every library model
+    # traced under each eligible impl, plus the diffusion re-expression
+    ir = {f"ir_{m}_{i}" for m in ("gray_scott", "sir", "predator_prey")
+          for i in ("xla", "composed", "active")} | {"ir_diffusion_xla"}
     assert set(CONTRACTS) == {"dense", "composed", "active", "ensemble",
-                              "active_fused", "active_fused_runner"}
+                              "active_fused", "active_fused_runner"} | ir
 
 
 def test_jaxpr_audit_dense_golden():

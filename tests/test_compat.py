@@ -182,3 +182,20 @@ def test_fused_active_kernel_through_the_bridges():
                                          selfnz)
     out = np.asarray(padded)[1:-1, 1:-1]
     assert out[10, 10] != 0.0 and out.sum() == pytest.approx(1.5)
+
+
+def test_optimization_barrier_bridge_batches_under_vmap():
+    """The 0.4.x line ships no batching rule for optimization_barrier;
+    the compat bridge registers the identity passthrough (the IR
+    lowering's pointwise amounts run both serially and inside the
+    ensemble's vmapped parametric step). Value passthrough + vmap +
+    vmap-of-jit must all work."""
+    from mpi_model_tpu.compat import optimization_barrier
+
+    x = jnp.arange(12.0, dtype=jnp.float64).reshape(3, 4)
+    np.testing.assert_array_equal(
+        np.asarray(optimization_barrier(x)), np.asarray(x))
+    f = jax.jit(jax.vmap(lambda a, b: optimization_barrier(a * b) + a))
+    out = f(x, x)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(x * x + x))

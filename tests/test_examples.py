@@ -12,7 +12,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.mark.slow  # subprocess-spawning: full interpreter + jax init per script
 @pytest.mark.parametrize("script", ["reference_run.py", "scaling.py",
-                                    "masked_lake.py"])
+                                    "masked_lake.py",
+                                    "reaction_diffusion.py"])
 def test_example_runs(script):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
