@@ -18,12 +18,16 @@ from .registry import (RULES, Finding, Pragma, Rule,  # noqa: F401
                        Severity, collect_pragmas, rule)
 from .astlint import (audit_test_module, iter_py_files,  # noqa: F401
                       lint_file, lint_source, parse_module, run_astlint)
+from .concurrency import (SCOPE_CONCURRENCY,  # noqa: F401
+                          lint_concurrency_source, run_concurrency_audit,
+                          static_lock_graph)
 
 __all__ = [
     "RULES", "Finding", "Pragma", "Rule", "Severity", "collect_pragmas",
     "rule", "audit_test_module", "iter_py_files", "lint_file",
     "lint_source", "parse_module", "run_astlint", "run_jaxpr_audit",
-    "main",
+    "SCOPE_CONCURRENCY", "lint_concurrency_source",
+    "run_concurrency_audit", "static_lock_graph", "main",
 ]
 
 

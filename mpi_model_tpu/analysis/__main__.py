@@ -17,6 +17,7 @@ from pathlib import Path
 
 from .registry import RULES, Severity
 from .astlint import run_astlint
+from .concurrency import SCOPE_CONCURRENCY, run_concurrency_audit
 # registering the jaxpr contract rules is import-time cheap (jax itself
 # loads lazily inside the audit) and makes --rule/--list-rules see the
 # full rule table
@@ -57,7 +58,7 @@ def main(argv=None) -> int:
             print(f"{r.name:18} {r.severity!s:8} {r.scope:8} {r.doc}")
         return 0
 
-    ast_rules = jaxpr_rules = None
+    ast_rules = jaxpr_rules = conc_rules = None
     if args.rules:
         unknown = [r for r in args.rules if r not in RULES]
         if unknown:
@@ -65,9 +66,12 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         ast_rules = [r for r in args.rules
-                     if RULES[r].scope != SCOPE_JAXPR]
+                     if RULES[r].scope not in (SCOPE_JAXPR,
+                                               SCOPE_CONCURRENCY)]
         jaxpr_rules = [r for r in args.rules
                        if RULES[r].scope == SCOPE_JAXPR]
+        conc_rules = [r for r in args.rules
+                      if RULES[r].scope == SCOPE_CONCURRENCY]
 
     root = _repo_root()
     if args.paths:
@@ -81,6 +85,21 @@ def main(argv=None) -> int:
     if ast_rules or not args.rules:
         findings.extend(run_astlint(roots, rules=ast_rules,
                                     rel_to=rel_to))
+    if conc_rules or not args.rules:
+        # layer 3 is whole-program: it always models the FULL package
+        # (a path-scoped model would silently lose cross-module call
+        # resolution — edges and blocking chains would vanish); when
+        # the user named paths, only findings IN those paths are
+        # reported
+        conc = run_concurrency_audit(
+            rules=conc_rules, rel_to=None if args.paths else rel_to)
+        if args.paths:
+            wanted = [Path(p).resolve() for p in args.paths]
+            conc = [f for f in conc
+                    if any(rp == w or w in rp.parents
+                           for w in wanted
+                           for rp in (Path(f.path).resolve(),))]
+        findings.extend(conc)
     run_audit = (jaxpr_rules
                  or (args.strict and not args.no_jaxpr and not args.rules))
     if run_audit:

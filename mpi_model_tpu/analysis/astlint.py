@@ -605,117 +605,38 @@ def check_naked_save(ctx: ModuleCtx):
 # The ensemble scheduler/service now run submit/poll on client threads
 # while a pump thread dispatches: every class that owns a dispatch lock
 # must route its shared-state writes through it. This rule is the
-# structural enforcement: in any module that imports ``threading``, a
-# class that binds a lock ANYWHERE in its body (an attribute whose name
-# contains lock/mutex/cond/cv — __init__ or, since ISSUE 10, any other
-# method: the fleet supervisor's state made late-bound locks a real
-# shape) may only write ``self.*`` state inside a
-# ``with self.<lock>:`` block. Escapes: ``__init__`` itself
-# (construction happens-before publication), methods whose name ends in
-# ``_locked`` (the caller-holds-the-lock convention, self-documenting),
-# and the pragma. Writes = Assign/AugAssign/AnnAssign/Delete whose
-# target is rooted at ``self`` (attribute or subscript chains included:
-# ``self.x = ...``, ``self.d[k] = ...``, ``self.a.b += 1``,
-# ``del self.d[k]``); method-CALL mutations (``self.list.append``) are
-# out of scope — the rule catches the lost-update/torn-read shapes, the
-# review catches the rest.
+# structural enforcement: in any threaded module (imports ``threading``
+# or the ``resilience.lockdep`` lock factories — one definition, shared
+# with the concurrency layer), a class that binds a lock ANYWHERE in
+# its body (an attribute whose name contains lock/mutex/cond/cv —
+# __init__ or, since ISSUE 10, any other method: the fleet supervisor's
+# state made late-bound locks a real shape) may only write ``self.*``
+# state inside a ``with self.<lock>:`` block. Escapes: ``__init__``
+# itself (construction happens-before publication), methods whose name
+# ends in ``_locked`` (the caller-holds-the-lock convention,
+# self-documenting), and the pragma. Writes = Assign/AugAssign/
+# AnnAssign/Delete whose target is rooted at ``self`` (attribute or
+# subscript chains included: ``self.x = ...``, ``self.d[k] = ...``,
+# ``self.a.b += 1``, ``del self.d[k]``); method-CALL mutations
+# (``self.list.append``) are out of scope — the rule catches the
+# lost-update/torn-read shapes, the review catches the rest.
+#
+# ISSUE 12 deduplicated the lock-detection machinery: what counts as a
+# lock, a threaded module, a self-rooted write or a guarded region is
+# defined ONCE in ``analysis.concurrency`` (the shared lock model the
+# acquisition-graph rules build on) and re-fronted here.
 
-import re as _re
-
-#: attribute names that read as a synchronization primitive. The
-#: tokens are anchored at name-segment boundaries: `_lock`, `lock_cv`,
-#: `_condition` qualify; `_clock`, `block_size`, `seconds` must NOT —
-#: a bare substring match would classify a scheduler's injectable
-#: `self._clock` as a lock and emit `with self._clock:` guidance.
-_LOCKISH = _re.compile(
-    r"(?:^|_)(?:lock|mutex|condition|cond|cv)(?:$|_)", _re.IGNORECASE)
-
-
-def _target_root(node: ast.AST) -> Optional[ast.AST]:
-    """The root expression of an assignment-target chain
-    (``self.a.b[k]`` → the ``self`` Name), descending Attribute/
-    Subscript/Starred wrappers."""
-    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
-        node = node.value
-    return node
-
-
-def _self_write_targets(node: ast.AST) -> list[ast.AST]:
-    """Assignment-target expressions rooted at ``self`` for a write
-    statement (tuple targets unpacked), else []."""
-    if isinstance(node, ast.Assign):
-        targets = list(node.targets)
-    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
-        targets = [node.target]
-    elif isinstance(node, ast.Delete):
-        targets = list(node.targets)
-    else:
-        return []
-    flat: list[ast.AST] = []
-    for t in targets:
-        if isinstance(t, (ast.Tuple, ast.List)):
-            flat.extend(t.elts)
-        else:
-            flat.append(t)
-    out = []
-    for t in flat:
-        if isinstance(t, ast.Name):
-            continue  # plain local — never shared state
-        root = _target_root(t)
-        if isinstance(root, ast.Name) and root.id == "self":
-            out.append(t)
-    return out
-
-
-def _module_imports_threading(tree: ast.Module) -> bool:
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            if any(a.name.split(".")[0] == "threading"
-                   for a in node.names):
-                return True
-        elif isinstance(node, ast.ImportFrom):
-            if (node.module or "").split(".")[0] == "threading":
-                return True
-    return False
-
-
-def _lock_attrs_bound_in_class(cls: ast.ClassDef) -> set[str]:
-    """Names of self.<attr> bound ANYWHERE in the class whose attr
-    reads as a lock (``self._lock = threading.RLock()``,
-    ``self._lock_cv = ...``). Originally this only scanned __init__;
-    ISSUE 10 extends it to every method so a supervisor that creates or
-    replaces a synchronization primitive outside construction (e.g. a
-    fleet respawning per-generation state) is still classified as
-    lock-owning — a lock bound late protects state exactly as much as
-    one bound in __init__, and skipping the class would silently waive
-    the whole rule for it."""
-    out: set[str] = set()
-    for stmt in cls.body:
-        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for node in ast.walk(stmt):
-                for t in _self_write_targets(node):
-                    if (isinstance(t, ast.Attribute)
-                            and _LOCKISH.search(t.attr)):
-                        out.add(t.attr)
-    return out
+from .concurrency import (LOCKISH as _LOCKISH,  # noqa: F401
+                          lock_attrs_bound_in_class as
+                          _lock_attrs_bound_in_class,
+                          module_is_threaded as _module_is_threaded,
+                          self_write_targets as _self_write_targets,
+                          under_lock_with as _under_lock_with_parents)
 
 
 def _under_lock_with(ctx: ModuleCtx, node: ast.AST,
                      method: ast.AST) -> bool:
-    """True when ``node`` sits inside a ``with self.<lockish>:`` (or
-    Condition) block within ``method``."""
-    cur = ctx.parents.get(node)
-    while cur is not None and cur is not method:
-        if isinstance(cur, (ast.With, ast.AsyncWith)):
-            for item in cur.items:
-                for n in ast.walk(item.context_expr):
-                    if (isinstance(n, ast.Attribute)
-                            and _LOCKISH.search(n.attr)):
-                        root = _target_root(n)
-                        if isinstance(root, ast.Name) and root.id == "self":
-                            return True
-        cur = ctx.parents.get(cur)
-    return False
+    return _under_lock_with_parents(ctx.parents, node, method)
 
 
 @rule("unguarded-shared-mutation", Severity.ERROR,
@@ -725,7 +646,7 @@ def _under_lock_with(ctx: ModuleCtx, node: ast.AST,
       "pragma) — an unlocked write races the pump thread",
       scope=SCOPE_PACKAGE)
 def check_unguarded_shared_mutation(ctx: ModuleCtx):
-    if not _module_imports_threading(ctx.tree):
+    if not _module_is_threaded(ctx.tree):
         return
     for cls in ast.walk(ctx.tree):
         if not isinstance(cls, ast.ClassDef):

@@ -40,7 +40,6 @@ from __future__ import annotations
 
 import copy
 import dataclasses
-import threading
 import time as _time
 from typing import Callable, Optional, Sequence
 
@@ -51,7 +50,7 @@ import numpy as np
 from ..core.cellular_space import CellularSpace, first_float_dtype
 from ..models.model import (ConservationError, Model, Report,
                             default_conservation_rtol)
-from ..resilience import inject
+from ..resilience import inject, lockdep
 from ..ops.flow import Diffusion, PointFlow, build_outflow
 from ..ops.stencil import neighbor_counts_traced, point_flow_step, transport
 
@@ -504,7 +503,7 @@ class EnsembleExecutor:
         #: service dispatches inline on whichever client thread filled
         #: the bucket — two racing submitters must not double-compile a
         #: runner or lose counter updates (ISSUE 9 thread-safety work)
-        self._cache_lock = threading.Lock()
+        self._cache_lock = lockdep.lock("EnsembleExecutor._cache_lock")
         self._cache: dict = {}
         #: runner-build / cache-hit counters (the scheduler's
         #: compile-cache-hit fields read these)
@@ -541,11 +540,19 @@ class EnsembleExecutor:
                 return runner
             self.builds += 1
             if self.impl == "pipeline":
+                # analysis: ignore[blocking-under-lock] — serializing
+                # the miss is the point (two racing sync submitters
+                # must get one build, one hit); builder device work is
+                # the cost of the single-build guarantee
                 runner = self._build_pipeline(model, espace, uniform_rates)
             elif self.impl in ("active", "active_fused"):
+                # analysis: ignore[blocking-under-lock] — serialize the
+                # miss (see the pipeline branch)
                 runner = self._build_active(
                     model, espace, fused=self.impl == "active_fused")
             else:
+                # analysis: ignore[blocking-under-lock] — serialize the
+                # miss (see the pipeline branch)
                 runner = self._build_xla(model, espace, donate=donate)
             self._cache[key] = runner
             return runner

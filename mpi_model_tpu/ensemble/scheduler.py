@@ -82,13 +82,12 @@ from __future__ import annotations
 import collections
 import dataclasses
 import itertools
-import threading
 import time
 import warnings
 from typing import Callable, Optional, Sequence
 
 from ..core.cellular_space import CellularSpace
-from ..resilience import inject
+from ..resilience import inject, lockdep
 from ..utils.metrics import ThroughputCounter
 from .batch import (EnsembleExecutor, complete_ensemble, launch_ensemble,
                     padding_scenarios, structure_key)
@@ -264,7 +263,9 @@ class EnsembleScheduler:
         #: (queues, results, pending set, logs, ladder state) holds it;
         #: device work (launch/complete) runs OUTSIDE it. RLock so the
         #: sync path's nested submit→dispatch→publish chain re-enters.
-        self._lock = threading.RLock()
+        #: Built through the lockdep factory (ISSUE 12): plain RLock
+        #: when the witness is disarmed, order-recorded when armed.
+        self._lock = lockdep.rlock("EnsembleScheduler._lock")
         self._queues: collections.OrderedDict[tuple, list[_Pending]] = \
             collections.OrderedDict()
         self._results: dict[int, object] = {}
@@ -529,6 +530,10 @@ class EnsembleScheduler:
 
             # verify-then-drain: a transfer that fails its CRCs raises
             # HERE, with the scenario still queued locally
+            # analysis: ignore[blocking-under-lock] — the CRC-verified
+            # materialization must complete while the ticket is still
+            # queued under this lock, or a failed transfer could both
+            # lose the local copy and never deliver the remote one
             space = transfer_space(it.space)
             q.pop(i)
             if not q:

@@ -1,0 +1,287 @@
+"""Runtime lockdep witness (ISSUE 12): armable lock wrappers that record
+actual acquisition orders and assert them against the static
+acquisition graph (``analysis.concurrency.static_lock_graph``).
+
+The static layer proves the lock-order invariants over every path it can
+resolve; this module witnesses the orders that actually happen — under
+the chaos matrix and the soak driver — and catches what static analysis
+structurally cannot: same-key nesting across two INSTANCES of one class
+(statically indistinguishable from a legal RLock re-entry) and any
+acquisition through a call path the resolver could not follow.
+
+Same one-global-read-when-disarmed discipline as ``inject``: the
+threaded modules create their locks through the factories below
+(``lock``/``rlock``/``condition``); while no witness is armed each
+factory returns the PLAIN ``threading`` primitive — zero wrapper, zero
+overhead, byte-identical behavior. Objects constructed inside an
+``armed()`` block get witnessed locks that report only WHILE that same
+witness stays armed: once the block exits, their acquisitions go
+unrecorded (the wrappers keep working, they just stop reporting) — so
+a test must keep the work it wants witnessed, including ``stop()``,
+inside the armed block.
+
+What the witness records per acquisition, keyed by the lock's stable
+string key (``"EnsembleScheduler._lock"`` — the same key the static
+graph uses):
+
+- **edges** — ``(held_key, acquired_key)`` for every distinct lock held
+  at acquisition time (re-entry on the same instance is not an edge);
+- **inversions** — an edge whose reverse was already observed, from any
+  thread: the two orders together are a deadlock waiting for the right
+  interleaving;
+- **same-key nesting** — the same key on two different instances, the
+  case the static layer must wave through for re-entrant locks;
+- **unknown edges** — when armed with ``allowed=static_lock_graph()``,
+  any observed order the static graph does not contain (either the
+  graph regressed or a resolver gap just got witnessed — both are
+  findings).
+
+``Condition.wait`` releases the lock for the duration of the wait; the
+wrapper suspends the key from the thread's held set around it so a
+parked waiter can never fabricate an ordering edge.
+
+Locks are host-side only — arming the witness cannot touch a step jaxpr
+(pinned by ``tests/test_lockdep.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+__all__ = [
+    "LockOrderViolation",
+    "LockWitness",
+    "active",
+    "armed",
+    "condition",
+    "lock",
+    "rlock",
+]
+
+
+class LockOrderViolation(AssertionError):
+    """Raised by ``LockWitness.assert_clean`` — carries the recorded
+    violations so a failing chaos row prints the actual orders."""
+
+    def __init__(self, violations: list):
+        super().__init__(
+            "lockdep witnessed %d ordering violation(s):\n%s" % (
+                len(violations),
+                "\n".join(f"  [{v['kind']}] {v['a']} vs {v['b']} "
+                          f"(thread {v['thread']})" for v in violations)))
+        self.violations = violations
+
+
+class LockWitness:
+    """Runtime state of one armed witness: per-thread held stacks, the
+    observed edge set, and the violation log (never raises mid-serve —
+    a witnessed fleet must keep serving; tests assert afterwards)."""
+
+    def __init__(self, allowed: Optional[set] = None):
+        #: the static graph to assert against (None = learn-only)
+        self.allowed = None if allowed is None else set(allowed)
+        self._mu = threading.Lock()  # leaf lock guarding the records
+        self._tls = threading.local()
+        #: (held_key, acquired_key) → name of the first witnessing thread
+        self.edges: dict = {}
+        #: [{"kind", "a", "b", "thread"}] in observation order
+        self.violations: list = []
+        self._flagged: set = set()
+
+    # -- bookkeeping (called by the wrappers) --------------------------------
+
+    def _stack(self) -> list:
+        s = getattr(self._tls, "stack", None)
+        if s is None:
+            s = []
+            self._tls.stack = s
+        return s
+
+    def note_acquiring(self, lk: "_WitnessLock") -> None:
+        stack = self._stack()
+        if any(h is lk for h in stack):
+            stack.append(lk)  # same-instance re-entry: never an edge
+            return
+        held: list = []
+        seen: set = set()
+        for h in stack:
+            if id(h) not in seen:
+                seen.add(id(h))
+                held.append(h)
+        if held:
+            with self._mu:
+                for h in held:
+                    self._edge(h.key, lk.key)
+        stack.append(lk)
+
+    def note_release(self, lk: "_WitnessLock") -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lk:
+                del stack[i]
+                return
+
+    def suspend(self, lk: "_WitnessLock") -> int:
+        """Remove every held entry of ``lk`` (Condition.wait releases
+        the lock fully, saved re-entries included); returns the count
+        for ``resume``."""
+        stack = self._stack()
+        n = sum(1 for h in stack if h is lk)
+        if n:
+            self._tls.stack = [h for h in stack if h is not lk]
+        return n
+
+    def resume(self, lk: "_WitnessLock", n: int) -> None:
+        """Re-hold after a wait — no new edges: the thread was parked,
+        every ordering fact was recorded at the original acquire."""
+        if n:
+            self._stack().extend([lk] * n)
+
+    def _violation(self, kind: str, a: str, b: str) -> None:
+        sig = (kind, a, b) if kind != "inversion" else (
+            kind, *sorted((a, b)))
+        if sig in self._flagged:
+            return
+        self._flagged.add(sig)
+        self.violations.append({
+            "kind": kind, "a": a, "b": b,
+            "thread": threading.current_thread().name})
+
+    def _edge(self, held_key: str, new_key: str) -> None:
+        if held_key == new_key:
+            # same key, DIFFERENT instance (same-instance re-entry was
+            # filtered upstream): the nesting the static layer cannot
+            # distinguish from a legal RLock re-entry — here it is real
+            self._violation("same-key-nesting", held_key, new_key)
+            return
+        e = (held_key, new_key)
+        if e not in self.edges:
+            self.edges[e] = threading.current_thread().name
+        if (new_key, held_key) in self.edges:
+            self._violation("inversion", held_key, new_key)
+        if self.allowed is not None and e not in self.allowed:
+            self._violation("unknown-edge", held_key, new_key)
+
+    # -- assertions ----------------------------------------------------------
+
+    def assert_clean(self) -> None:
+        if self.violations:
+            raise LockOrderViolation(list(self.violations))
+
+
+class _WitnessLock:
+    """Wraps one threading primitive; quacks like Lock/RLock/Condition
+    (the surface the serving stack uses: with, acquire/release, wait,
+    wait_for, notify, notify_all)."""
+
+    __slots__ = ("key", "_inner", "_witness")
+
+    def __init__(self, key: str, inner, witness: LockWitness):
+        self.key = key
+        self._inner = inner
+        self._witness = witness
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        st = _ACTIVE
+        if st is self._witness and st is not None:
+            # record BEFORE blocking — the lockdep way: an inversion is
+            # witnessed even if this acquire is the one that deadlocks
+            st.note_acquiring(self)
+        ok = self._inner.acquire(blocking, timeout)
+        if not ok and _ACTIVE is self._witness and _ACTIVE is not None:
+            self._witness.note_release(self)
+        return ok
+
+    def release(self):
+        if _ACTIVE is self._witness and _ACTIVE is not None:
+            self._witness.note_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition surface (present only when the inner object has it;
+    # AttributeError on a plain Lock is the same error threading gives)
+
+    def wait(self, timeout: Optional[float] = None):
+        st = _ACTIVE if _ACTIVE is self._witness else None
+        n = st.suspend(self) if st is not None else 0
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            if st is not None:
+                st.resume(self, n)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        st = _ACTIVE if _ACTIVE is self._witness else None
+        n = st.suspend(self) if st is not None else 0
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            if st is not None:
+                st.resume(self, n)
+
+    def notify(self, n: int = 1):
+        return self._inner.notify(n)
+
+    def notify_all(self):
+        return self._inner.notify_all()
+
+
+_ACTIVE: Optional[LockWitness] = None
+
+
+def active() -> Optional[LockWitness]:
+    """The armed witness, or None — THE fast path the factories check
+    (one global read when lockdep is off)."""
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def armed(allowed: Optional[set] = None):
+    """Arm a witness for the duration of the block (one at a time —
+    overlapping witnesses would split the edge history). Locks created
+    inside the block are instrumented; pass
+    ``allowed=analysis.concurrency.static_lock_graph()`` to also flag
+    any observed order the static graph does not contain."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("a lockdep witness is already armed")
+    w = LockWitness(allowed)
+    _ACTIVE = w
+    try:
+        yield w
+    finally:
+        _ACTIVE = None
+
+
+def lock(key: str):
+    """A (non-reentrant) mutex — plain ``threading.Lock()`` when no
+    witness is armed, a witnessed wrapper otherwise. ``key`` is the
+    stable order-class name shared with the static graph."""
+    st = _ACTIVE
+    inner = threading.Lock()
+    return inner if st is None else _WitnessLock(key, inner, st)
+
+
+def rlock(key: str):
+    """A re-entrant mutex (``threading.RLock``), witnessed when armed."""
+    st = _ACTIVE
+    inner = threading.RLock()
+    return inner if st is None else _WitnessLock(key, inner, st)
+
+
+def condition(key: str):
+    """A ``threading.Condition`` (re-entrant underneath), witnessed when
+    armed — ``wait`` suspends the key from the held set, so a parked
+    waiter never fabricates an ordering edge."""
+    st = _ACTIVE
+    inner = threading.Condition()
+    return inner if st is None else _WitnessLock(key, inner, st)

@@ -12,9 +12,10 @@ alone does not block through the tunnel).
 from __future__ import annotations
 
 import collections
-import threading
 import time
 from typing import Callable, Optional
+
+from ..resilience import lockdep
 
 Values = dict
 
@@ -248,7 +249,10 @@ class ThroughputCounter:
                 "member_faults", "readmitted", "scale_ups", "scale_downs")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # lockdep factory (ISSUE 12): plain Lock disarmed, witnessed
+        # when the order witness is armed — the counter lock is a LEAF
+        # of the static acquisition graph (bump/snapshot call nothing)
+        self._lock = lockdep.lock("ThroughputCounter._lock")
         self.dispatches = 0
         self.scenarios = 0
         self.lanes = 0

@@ -16,6 +16,9 @@ import pytest
 
 from mpi_model_tpu.analysis import (RULES, Severity, lint_source, main,
                                     run_astlint)
+from mpi_model_tpu.analysis.concurrency import (lint_concurrency_source,
+                                                run_concurrency_audit,
+                                                static_lock_graph)
 from mpi_model_tpu.analysis.__main__ import DEFAULT_ROOTS
 from mpi_model_tpu.analysis.jaxpr_audit import (CONTRACTS, BuiltStep,
                                                 audit_built,
@@ -303,10 +306,16 @@ def test_rule_registry_is_complete():
                  "dtype-drift", "traced-branch", "heavy-test",
                  "bare-pragma", "parse-error",
                  "jaxpr-dtype", "jaxpr-callback", "jaxpr-consts",
-                 "jaxpr-halo", "jaxpr-fused-flags"):
+                 "jaxpr-halo", "jaxpr-fused-flags",
+                 "lock-order", "blocking-under-lock", "lock-leak",
+                 "thread-shared-without-lock"):
         assert want in RULES, want
     assert RULES["broad-except"].severity is Severity.ERROR
     assert RULES["dtype-drift"].severity is Severity.WARNING
+    assert RULES["lock-order"].severity is Severity.ERROR
+    assert RULES["lock-leak"].severity is Severity.ERROR
+    assert RULES["blocking-under-lock"].severity is Severity.WARNING
+    assert RULES["thread-shared-without-lock"].severity is Severity.WARNING
 
 
 def test_cli_json_and_exit_codes(tmp_path, capsys):
@@ -523,12 +532,14 @@ def test_naked_save_pragma_suppresses_with_reason():
 
 
 def test_repo_is_clean_under_strict_analysis():
-    """THE gate (ISSUE 4 acceptance): zero unsuppressed findings of any
-    severity over the whole tree, every suppression carries a reason,
-    and all four step-impl contracts audit clean. This is the in-process
-    equivalent of ``python -m mpi_model_tpu.analysis --strict``."""
+    """THE gate (ISSUE 4 acceptance; ISSUE 12 adds layer 3): zero
+    unsuppressed findings of any severity over the whole tree — AST
+    lint, concurrency audit AND jaxpr contracts — with every
+    suppression carrying a reason. This is the in-process equivalent of
+    ``python -m mpi_model_tpu.analysis --strict``."""
     roots = [REPO / p for p in DEFAULT_ROOTS if (REPO / p).exists()]
     findings = run_astlint(roots, rel_to=REPO)
+    findings.extend(run_concurrency_audit(roots, rel_to=REPO))
     findings.extend(run_jaxpr_audit())
     blocking = [f for f in findings if not f.suppressed]
     assert blocking == [], "\n" + "\n".join(f.format() for f in blocking)
@@ -728,3 +739,266 @@ def test_wall_clock_in_test_catches_module_alias():
            "    _t.monotonic()\n")  # monotonic stays legal, aliased too
     assert rules_of(lint_source(src, "tests/test_fake.py")) == (
         ["wall-clock-in-test"])
+
+
+# -- concurrency audit (ISSUE 12 layer 3): lock model + acquisition graph -----
+
+def conc_rules_of(findings, unsuppressed=True):
+    return [f.rule for f in findings
+            if not (unsuppressed and f.suppressed)]
+
+
+_PEERED = (
+    "import threading\n"
+    "class Pong:\n"
+    "    def __init__(self):\n"
+    "        self._pong_lock = threading.Lock()\n"
+    "        self.peer: 'Ping' = None\n"
+    "    def absorb(self):\n"
+    "        with self._pong_lock:\n"
+    "            pass\n"
+    "    def rally(self):\n"
+    "        with self._pong_lock:\n"
+    "            self.peer.absorb()\n"
+    "class Ping:\n"
+    "    def __init__(self):\n"
+    "        self._ping_lock = threading.Lock()\n"
+    "        self.peer = Pong()\n"
+    "    def absorb(self):\n"
+    "        with self._ping_lock:\n"
+    "            pass\n"
+    "    def serve(self):\n"
+    "        with self._ping_lock:\n"
+    "            self.peer.absorb()\n")
+
+
+def test_lock_order_cycle_flagged():
+    # Ping nests ping→pong, Pong nests pong→ping: a classic inversion —
+    # both edges of the cycle are named, as ERRORs
+    out = [f for f in lint_concurrency_source(_PEERED)
+           if f.rule == "lock-order"]
+    assert len(out) == 2
+    assert all(f.severity is Severity.ERROR for f in out)
+    assert all("cycle" in f.message for f in out)
+
+
+def test_lock_order_consistent_nesting_is_clean():
+    # one global order (only Ping nests into Pong): a DAG, no findings
+    src = _PEERED.replace("            self.peer.absorb()\n"
+                          "class Ping", "            pass\nclass Ping", 1)
+    assert conc_rules_of(lint_concurrency_source(src)) == []
+
+
+def test_lock_order_same_key_nonreentrant_flagged():
+    # a plain Lock re-acquired through a helper call is a self-deadlock
+    src = ("import threading\n"
+           "class Box:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def inner(self):\n"
+           "        with self._lock:\n"
+           "            pass\n"
+           "    def outer(self):\n"
+           "        with self._lock:\n"
+           "            self.inner()\n")
+    out = lint_concurrency_source(src)
+    assert conc_rules_of(out) == ["lock-order"]
+    assert "non-reentrant" in out[0].message
+    # the same shape on an RLock is the sanctioned re-entry — clean
+    src2 = src.replace("threading.Lock()", "threading.RLock()")
+    assert conc_rules_of(lint_concurrency_source(src2)) == []
+
+
+def test_lock_order_pragma_escape():
+    src = ("import threading\n"
+           "class Box:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def outer(self):\n"
+           "        with self._lock:\n"
+           "            # analysis: ignore[lock-order] — init-time only\n"
+           "            with self._lock:\n"
+           "                pass\n")
+    out = lint_concurrency_source(src)
+    assert conc_rules_of(out) == []
+    assert any(f.suppressed for f in out)
+
+
+_LOCKED_IO = (
+    "import threading\n"
+    "import time\n"
+    "class S:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.fh = None\n")
+
+
+def test_blocking_under_lock_direct_shapes():
+    src = (_LOCKED_IO +
+           "    def a(self):\n"
+           "        with self._lock:\n"
+           "            time.sleep(0.1)\n"
+           "    def b(self):\n"
+           "        with self._lock:\n"
+           "            self.fh.write(b'x')\n"
+           "    def c(self, t):\n"
+           "        with self._lock:\n"
+           "            t.join()\n")
+    assert conc_rules_of(lint_concurrency_source(src)) == (
+        ["blocking-under-lock"] * 3)
+
+
+def test_blocking_under_lock_in_caller_holds_method():
+    # a *_locked method's body IS a lock-held region by convention
+    src = (_LOCKED_IO +
+           "    def _flush_locked(self):\n"
+           "        self.fh.flush()\n")
+    assert conc_rules_of(lint_concurrency_source(src)) == (
+        ["blocking-under-lock"])
+
+
+def test_blocking_under_lock_through_resolved_call_chain():
+    src = (_LOCKED_IO +
+           "    def helper(self):\n"
+           "        time.sleep(0.1)\n"
+           "    def e(self):\n"
+           "        with self._lock:\n"
+           "            self.helper()\n")
+    out = lint_concurrency_source(src)
+    assert conc_rules_of(out) == ["blocking-under-lock"]
+    assert "S.helper" in out[0].message  # the chain is named
+
+
+def test_blocking_under_lock_negatives():
+    # no lock held; Condition.wait (releases the lock); a nested def
+    # under the with (runs later, not here); os.path.join / str.join
+    src = (_LOCKED_IO +
+           "    def f(self):\n"
+           "        time.sleep(0.1)\n"
+           "    def g(self, cv):\n"
+           "        with self._lock:\n"
+           "            cv.wait(1.0)\n"
+           "    def h(self):\n"
+           "        with self._lock:\n"
+           "            def later():\n"
+           "                time.sleep(1.0)\n"
+           "            self.cb = later\n"
+           "    def i(self, os, parts):\n"
+           "        with self._lock:\n"
+           "            p = os.path.join('a', 'b')\n"
+           "            s = ', '.join(parts)\n"
+           "            return p, s\n")
+    assert conc_rules_of(lint_concurrency_source(src)) == []
+
+
+def test_blocking_under_lock_pragma_escape():
+    src = (_LOCKED_IO +
+           "    def p(self):\n"
+           "        with self._lock:\n"
+           "            # analysis: ignore[blocking-under-lock] — "
+           "deliberate: serialize the miss\n"
+           "            time.sleep(0.1)\n")
+    out = lint_concurrency_source(src)
+    assert conc_rules_of(out) == []
+    assert any(f.suppressed and f.suppress_reason for f in out)
+
+
+def test_lock_leak_positive_and_negatives():
+    src = ("import threading\n"
+           "class L:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def bad(self):\n"
+           "        self._lock.acquire()\n"
+           "        self.n = 1\n"
+           "        self._lock.release()\n")
+    out = lint_concurrency_source(src, rules=["lock-leak"])
+    assert conc_rules_of(out) == ["lock-leak"]
+    # try/finally (acquire before OR inside the try) and `with` are fine
+    src2 = ("import threading\n"
+            "class L:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def ok(self):\n"
+            "        self._lock.acquire()\n"
+            "        try:\n"
+            "            self.n = 1\n"
+            "        finally:\n"
+            "            self._lock.release()\n"
+            "    def ok2(self):\n"
+            "        with self._lock:\n"
+            "            self.n = 2\n")
+    assert conc_rules_of(lint_concurrency_source(
+        src2, rules=["lock-leak"])) == []
+
+
+def test_thread_shared_without_lock_positive():
+    src = ("import threading\n"
+           "class Svc:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.state = 0\n"
+           "        self._t = threading.Thread(target=self._loop)\n"
+           "    def _loop(self):\n"
+           "        self.state = 1\n"
+           "    def peek(self):\n"
+           "        return self.state\n")
+    out = lint_concurrency_source(src,
+                                  rules=["thread-shared-without-lock"])
+    assert conc_rules_of(out) == ["thread-shared-without-lock"]
+    assert "Svc.state" in out[0].message
+
+
+def test_thread_shared_without_lock_negatives():
+    # any lock discipline on the attr → layer 1's territory; init-only
+    # writes happen-before the thread starts
+    src = ("import threading\n"
+           "class Svc:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.state = 0\n"
+           "        self.config = {}\n"
+           "        self._t = threading.Thread(target=self._loop)\n"
+           "    def _loop(self):\n"
+           "        with self._lock:\n"
+           "            self.state = 1\n"
+           "        n = self.config\n"
+           "    def peek(self):\n"
+           "        return self.state, self.config\n")
+    assert conc_rules_of(lint_concurrency_source(
+        src, rules=["thread-shared-without-lock"])) == []
+
+
+def test_static_lock_graph_has_the_serving_spine_and_no_two_cycles():
+    g = static_lock_graph()
+    # the load-bearing edges of the serving stack, by their runtime keys
+    for edge in (("FleetSupervisor._cv", "EnsembleScheduler._lock"),
+                 ("FleetSupervisor._cv", "AsyncEnsembleService._lock_cv"),
+                 ("AsyncEnsembleService._lock_cv",
+                  "EnsembleScheduler._lock"),
+                 ("EnsembleScheduler._lock", "ThroughputCounter._lock")):
+        assert edge in g, edge
+    for a, b in g:
+        assert (b, a) not in g, f"two-cycle {a} <-> {b}"
+
+
+def test_journal_append_under_fleet_lock_stays_visible_and_reasoned():
+    """ISSUE 12 satellite regression: the documented journal-append-
+    under-the-fleet-lock hazard must keep SURFACING (a suppressed
+    finding, never silence) and carry its reason — if a refactor moves
+    the append off the lock, this test goes stale and gets deleted
+    with the pragma; if someone deletes just the pragma, the strict
+    gate fails; if the rule stops seeing the hazard, this fails."""
+    findings = run_concurrency_audit()
+    hits = [f for f in findings
+            if f.rule == "blocking-under-lock"
+            and f.path.endswith("fleet.py")
+            and "TicketJournal.append" in f.message]
+    assert hits, "the journal-append hazard vanished from the audit"
+    assert all(f.suppressed and f.suppress_reason for f in hits)
+
+
+def test_cli_rule_filter_accepts_concurrency_rule_ids(capsys):
+    assert main(["--rule", "lock-order", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["blocking"] == []

@@ -946,6 +946,12 @@ def test_check_health_single_device_get(monkeypatch):
 # seams (member_kill / member_wedge) and the journal seam get their own
 # rows below; the deep per-kind semantics stay pinned by the dedicated
 # async rows above and tests/test_fleet.py.
+#
+# ISSUE 12: every fleet row below runs with the runtime lockdep witness
+# armed against the STATIC acquisition graph — each fleet is built
+# inside `lockdep.armed(allowed=...)`, so all its locks are witnessed
+# and every actual acquisition order under chaos must (a) contain no
+# inversion and (b) already be an edge the concurrency auditor proved.
 
 def _fleet(**kw):
     from mpi_model_tpu.ensemble import FleetSupervisor
@@ -954,6 +960,20 @@ def _fleet(**kw):
     kw.setdefault("steps", 4)
     kw.setdefault("retry", "solo")
     return FleetSupervisor(make_model(4.0), start=False, **kw)
+
+
+_ALLOWED_GRAPH = None
+
+
+def _allowed_graph():
+    """The static acquisition graph, computed once per session (it
+    AST-parses the whole package)."""
+    global _ALLOWED_GRAPH
+    if _ALLOWED_GRAPH is None:
+        from mpi_model_tpu.analysis.concurrency import static_lock_graph
+
+        _ALLOWED_GRAPH = static_lock_graph()
+    return _ALLOWED_GRAPH
 
 
 FLEET_MATRIX = {
@@ -988,25 +1008,34 @@ FLEET_MATRIX = {
 
 @pytest.mark.parametrize("kind", sorted(FLEET_MATRIX))
 def test_fleet_matrix_every_ticket_resolves(kind):
+    from mpi_model_tpu.resilience import lockdep
+
     faults, extra, expect = FLEET_MATRIX[kind]
     extra = dict(extra)
     if "clock" in extra:  # injectable clock rows (deadline semantics)
         clock = {"t": 0.0}
         extra["clock"] = lambda: clock["t"]
-    fleet = _fleet(**extra)
     served = failed = 0
-    with inject.armed(FaultPlan(faults)) as st, warnings.catch_warnings():
-        warnings.simplefilter("ignore", RuntimeWarning)
-        tickets = [fleet.submit(_scen_space(i)) for i in range(4)]
-        for t in tickets:
-            try:
-                fleet.result(t)
-                served += 1
-            # analysis: ignore[broad-except] — the matrix LEDGER: every
-            # non-served outcome must be counted, whatever chaos threw
-            # (per-kind semantics are pinned by the dedicated rows)
-            except Exception:
-                failed += 1
+    with lockdep.armed(allowed=_allowed_graph()) as witness:
+        fleet = _fleet(**extra)  # built armed: every lock is witnessed
+        with inject.armed(FaultPlan(faults)) as st, \
+                warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            tickets = [fleet.submit(_scen_space(i)) for i in range(4)]
+            for t in tickets:
+                try:
+                    fleet.result(t)
+                    served += 1
+                # analysis: ignore[broad-except] — the matrix LEDGER:
+                # every non-served outcome must be counted, whatever
+                # chaos threw (per-kind semantics are pinned by the
+                # dedicated rows)
+                except Exception:
+                    failed += 1
+    # the lockdep acceptance: chaos included, zero inversions and every
+    # observed order already proven by the static graph
+    assert witness.edges, f"{kind}: the witness saw no acquisitions"
+    witness.assert_clean()
     assert st.fired, f"{kind}: fault never fired"
     assert served + failed == 4          # zero silent drops
     stats = fleet.stats()
@@ -1028,67 +1057,81 @@ def test_fleet_matrix_member_kill_then_wedge():
     """The new member seams, matrix-style: a kill fences one member,
     then a wedge fences the member holding the NEXT wave — the stream
     keeps serving through BOTH fencings with a complete ledger and two
-    kind="member" events."""
+    kind="member" events. Lockdep-armed (ISSUE 12): fencing/restart is
+    the lock-heaviest supervision path, and it must stay inversion-free
+    and inside the static graph."""
+    from mpi_model_tpu.resilience import lockdep
+
     clock = {"t": 0.0}
-    fleet = _fleet(supervision_deadline_s=1.0, clock=lambda: clock["t"])
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore", RuntimeWarning)
-        # wave 1: kill whichever member holds the queue
-        tickets = [fleet.submit(_scen_space(i)) for i in range(3)]
-        victim = next(s["service_id"]
-                      for s in fleet.stats()["services"]
-                      if s["pending"] > 0)
-        with inject.armed(FaultPlan(
-                (Fault("member_kill", channel=victim),))) as st1:
-            outs = [fleet.result(t) for t in tickets]
-        # wave 2: wedge whichever member holds the new queue
-        wave2 = [fleet.submit(_scen_space(i), steps=3) for i in range(3)]
-        wedged = next(s["service_id"]
-                      for s in fleet.stats()["services"]
-                      if s["pending"] > 0)
-        with inject.armed(FaultPlan(
-                (Fault("member_wedge", channel=wedged,
-                       once=False),))) as st2:
-            fleet.pump_once()          # wedge holds the queue
-            clock["t"] = 2.0
-            fleet.pump_once()          # sig settles at the new clock
-            clock["t"] = 4.0
-            fleet.pump_once()          # deadline crossed → fence
-            outs2 = [fleet.result(t) for t in wave2]
+    with lockdep.armed(allowed=_allowed_graph()) as witness:
+        fleet = _fleet(supervision_deadline_s=1.0,
+                       clock=lambda: clock["t"])
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            # wave 1: kill whichever member holds the queue
+            tickets = [fleet.submit(_scen_space(i)) for i in range(3)]
+            victim = next(s["service_id"]
+                          for s in fleet.stats()["services"]
+                          if s["pending"] > 0)
+            with inject.armed(FaultPlan(
+                    (Fault("member_kill", channel=victim),))) as st1:
+                outs = [fleet.result(t) for t in tickets]
+            # wave 2: wedge whichever member holds the new queue
+            wave2 = [fleet.submit(_scen_space(i), steps=3)
+                     for i in range(3)]
+            wedged = next(s["service_id"]
+                          for s in fleet.stats()["services"]
+                          if s["pending"] > 0)
+            with inject.armed(FaultPlan(
+                    (Fault("member_wedge", channel=wedged,
+                           once=False),))) as st2:
+                fleet.pump_once()          # wedge holds the queue
+                clock["t"] = 2.0
+                fleet.pump_once()          # sig settles at the new clock
+                clock["t"] = 4.0
+                fleet.pump_once()          # deadline crossed → fence
+                outs2 = [fleet.result(t) for t in wave2]
+        stats = fleet.stats()
+        fleet.stop()
+    witness.assert_clean()
     assert {f["kind"] for f in st1.fired} == {"member_kill"}
     assert "member_wedge" in {f["kind"] for f in st2.fired}
     assert len(outs) == 3 and len(outs2) == 3
-    stats = fleet.stats()
     assert stats["member_faults"] == 2 and stats["pending"] == 0
     assert [e.kind for e in fleet.member_log] == ["member", "member"]
     assert {e.service_id for e in fleet.member_log} == {victim, wedged}
-    fleet.stop()
 
 
 def test_fleet_matrix_journal_torn_recovery(tmp_path):
     """journal_torn through the fleet: the torn suffix is lost, the
     verified prefix recovers — tickets whose submits survived resolve
-    after the restart, and the replay audit reports the tear."""
+    after the restart, and the replay audit reports the tear.
+    Lockdep-armed (ISSUE 12): the crash + recovery path replays the
+    journal under the fleet lock — it too must stay inside the static
+    graph with zero inversions."""
     from mpi_model_tpu.ensemble import FleetSupervisor
     from mpi_model_tpu.ensemble.journal import journal_path, replay
+    from mpi_model_tpu.resilience import lockdep
 
-    fleet = _fleet(journal_dir=str(tmp_path), max_wait_s=1e9,
-                   max_batch=8)
-    t0 = fleet.submit(_scen_space(0))
-    # tear the journal mid-record as the SECOND submit is appended: its
-    # record is the torn suffix, t0's record is the verified prefix
-    plan = FaultPlan((Fault("journal_torn", at=1, offset=3,
-                            tear="truncate"),))
-    with inject.armed(plan) as st:
-        fleet.submit(_scen_space(1))
-    assert [f["kind"] for f in st.fired] == ["journal_torn"]
-    fleet.abandon()                    # crash before anything served
-    state = replay(journal_path(str(tmp_path)))
-    assert state.torn is True
-    assert list(state.submits) == [t0]
-    f2 = FleetSupervisor.recover(str(tmp_path), make_model(4.0),
-                                 services=2, steps=4, start=False)
-    assert f2.result(t0) is not None   # the verified prefix recovers
-    f2.stop()
+    with lockdep.armed(allowed=_allowed_graph()) as witness:
+        fleet = _fleet(journal_dir=str(tmp_path), max_wait_s=1e9,
+                       max_batch=8)
+        t0 = fleet.submit(_scen_space(0))
+        # tear the journal mid-record as the SECOND submit is appended:
+        # its record is the torn suffix, t0's is the verified prefix
+        plan = FaultPlan((Fault("journal_torn", at=1, offset=3,
+                                tear="truncate"),))
+        with inject.armed(plan) as st:
+            fleet.submit(_scen_space(1))
+        assert [f["kind"] for f in st.fired] == ["journal_torn"]
+        fleet.abandon()                # crash before anything served
+        state = replay(journal_path(str(tmp_path)))
+        assert state.torn is True
+        assert list(state.submits) == [t0]
+        f2 = FleetSupervisor.recover(str(tmp_path), make_model(4.0),
+                                     services=2, steps=4, start=False)
+        assert f2.result(t0) is not None  # the verified prefix recovers
+        f2.stop()
+    witness.assert_clean()
     state2 = replay(journal_path(str(tmp_path)))
     assert state2.unresolved() == [] and not state2.duplicate_terminals

@@ -557,18 +557,28 @@ def test_recover_without_result_journaling_resolves_served_as_error(
 # -- the soak surface ---------------------------------------------------------
 
 def test_run_soak_fleet_ledger_complete_on_fake_clock():
+    """The soak leg of the ISSUE 12 acceptance rides here too: the
+    whole open-loop drive runs with the lockdep witness armed against
+    the static acquisition graph — zero recorded inversions, every
+    observed order already proven by the concurrency auditor."""
+    from mpi_model_tpu.analysis.concurrency import static_lock_graph
+    from mpi_model_tpu.resilience import lockdep
+
     clock = {"t": 0.0}
 
     def fake_sleep(dt):
         clock["t"] += dt
 
     model = scen_model()
-    fleet = manual_fleet(model, services=2, steps=2, max_queue=3,
-                         clock=lambda: clock["t"])
-    scen = [(scen_space(i % 3), None, None) for i in range(8)]
-    rep = run_soak(fleet, scen, arrival_rate_hz=1000.0,
-                   clock=lambda: clock["t"], sleep=fake_sleep)
-    fleet.stop()
+    with lockdep.armed(allowed=static_lock_graph()) as witness:
+        fleet = manual_fleet(model, services=2, steps=2, max_queue=3,
+                             clock=lambda: clock["t"])
+        scen = [(scen_space(i % 3), None, None) for i in range(8)]
+        rep = run_soak(fleet, scen, arrival_rate_hz=1000.0,
+                       clock=lambda: clock["t"], sleep=fake_sleep)
+        fleet.stop()
+    assert witness.edges, "the witness saw no acquisitions"
+    witness.assert_clean()
     assert rep["offered"] == 8
     assert rep["ledger_complete"] is True
     assert len(rep["services"]) == 2           # per-member attribution

@@ -1,0 +1,233 @@
+"""Runtime lockdep witness (ISSUE 12): factories are zero-overhead
+plain primitives when disarmed, record acquisition orders when armed,
+catch inversions / cross-instance same-key nesting / edges outside the
+static graph — and the deliberate-inversion fixture is caught by BOTH
+layers (statically as a ``lock-order`` ERROR, dynamically by the armed
+witness), which is the acceptance bar of the concurrency auditor."""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mpi_model_tpu.analysis.concurrency import (lint_concurrency_source,
+                                                static_lock_graph)
+from mpi_model_tpu.analysis.registry import Severity
+from mpi_model_tpu.resilience import lockdep
+
+
+# -- disarmed: plain primitives, zero wrapper ---------------------------------
+
+def test_factories_return_plain_primitives_when_disarmed():
+    assert not isinstance(lockdep.lock("K"), lockdep._WitnessLock)
+    assert not isinstance(lockdep.rlock("K"), lockdep._WitnessLock)
+    assert not isinstance(lockdep.condition("K"), lockdep._WitnessLock)
+    assert isinstance(lockdep.condition("K"), threading.Condition)
+    assert lockdep.active() is None
+
+
+def test_armed_is_exclusive_and_clears():
+    with lockdep.armed() as w:
+        assert lockdep.active() is w
+        with pytest.raises(RuntimeError, match="already armed"):
+            with lockdep.armed():
+                pass
+    assert lockdep.active() is None
+
+
+# -- armed: edges, re-entry, violations ---------------------------------------
+
+def test_witness_records_edges_and_same_instance_reentry_is_free():
+    with lockdep.armed() as w:
+        a = lockdep.lock("A")
+        b = lockdep.rlock("B")
+        with a:
+            with b:
+                with b:  # same-instance re-entry: never an edge
+                    pass
+    assert set(w.edges) == {("A", "B")}
+    assert w.violations == []
+    w.assert_clean()
+
+
+def test_inversion_is_caught_and_raises_on_assert():
+    with lockdep.armed() as w:
+        a = lockdep.lock("A")
+        b = lockdep.lock("B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    assert [v["kind"] for v in w.violations] == ["inversion"]
+    with pytest.raises(lockdep.LockOrderViolation, match="inversion"):
+        w.assert_clean()
+
+
+def test_same_key_nesting_across_instances_is_flagged():
+    # two schedulers' RLocks share the key: statically this is
+    # indistinguishable from a legal re-entry — the witness is the
+    # layer that can tell the instances apart
+    with lockdep.armed() as w:
+        a1 = lockdep.rlock("EnsembleScheduler._lock")
+        a2 = lockdep.rlock("EnsembleScheduler._lock")
+        with a1:
+            with a2:
+                pass
+    assert [v["kind"] for v in w.violations] == ["same-key-nesting"]
+
+
+def test_edge_outside_the_static_graph_is_flagged():
+    with lockdep.armed(allowed={("A", "B")}) as w:
+        a = lockdep.lock("A")
+        c = lockdep.lock("C")
+        with a:
+            with c:
+                pass
+    assert [v["kind"] for v in w.violations] == ["unknown-edge"]
+
+
+def test_condition_wait_suspends_and_resumes_the_held_key():
+    with lockdep.armed() as w:
+        c = lockdep.condition("C")
+        with c:
+            c.wait(timeout=0.01)  # releases fully; no edge fabricated
+            a = lockdep.lock("A")
+            with a:  # still held after the wait: a real edge
+                pass
+    assert set(w.edges) == {("C", "A")}
+    assert w.violations == []
+
+
+def test_cross_thread_inversion_is_caught():
+    with lockdep.armed() as w:
+        a = lockdep.lock("A")
+        b = lockdep.lock("B")
+        with a:
+            with b:
+                pass
+
+        def inverted():
+            with b:
+                with a:
+                    pass
+
+        t = threading.Thread(target=inverted)
+        t.start()
+        t.join()
+    assert [v["kind"] for v in w.violations] == ["inversion"]
+
+
+# -- the deliberate-inversion fixture, caught by BOTH layers ------------------
+
+INVERSION_FIXTURE = (
+    "import threading\n"
+    "class Pong:\n"
+    "    def __init__(self):\n"
+    "        self._pong_lock = threading.Lock()\n"
+    "        self.peer: 'Ping' = None\n"
+    "    def absorb(self):\n"
+    "        with self._pong_lock:\n"
+    "            pass\n"
+    "    def rally(self):\n"
+    "        with self._pong_lock:\n"
+    "            self.peer.absorb()\n"
+    "class Ping:\n"
+    "    def __init__(self):\n"
+    "        self._ping_lock = threading.Lock()\n"
+    "        self.peer = Pong()\n"
+    "    def absorb(self):\n"
+    "        with self._ping_lock:\n"
+    "            pass\n"
+    "    def serve(self):\n"
+    "        with self._ping_lock:\n"
+    "            self.peer.absorb()\n")
+
+
+def test_inversion_fixture_flagged_by_the_static_layer():
+    out = [f for f in lint_concurrency_source(INVERSION_FIXTURE)
+           if f.rule == "lock-order"]
+    assert len(out) == 2  # both edges of the cycle, named
+    assert all(f.severity is Severity.ERROR for f in out)
+
+
+def test_inversion_fixture_trips_the_runtime_witness():
+    # the same Ping/Pong nesting, executed on witnessed locks
+    class Pong:
+        def __init__(self):
+            self._pong_lock = lockdep.lock("Pong._pong_lock")
+            self.peer = None
+
+        def absorb(self):
+            with self._pong_lock:
+                pass
+
+        def rally(self):
+            with self._pong_lock:
+                self.peer.absorb()
+
+    class Ping:
+        def __init__(self):
+            self._ping_lock = lockdep.lock("Ping._ping_lock")
+            self.peer = Pong()
+
+        def absorb(self):
+            with self._ping_lock:
+                pass
+
+        def serve(self):
+            with self._ping_lock:
+                self.peer.absorb()
+
+    with lockdep.armed() as w:
+        ping = Ping()
+        ping.peer.peer = ping
+        ping.serve()       # ping → pong
+        ping.peer.rally()  # pong → ping: the inversion
+    assert [v["kind"] for v in w.violations] == ["inversion"]
+
+
+# -- the serving stack under the witness --------------------------------------
+
+def test_async_service_serves_clean_against_the_static_graph():
+    """A witnessed service (built INSIDE the armed block, so its locks
+    are instrumented) serves deterministically with every observed
+    acquisition order inside the static graph and zero inversions."""
+    import numpy as np
+
+    from mpi_model_tpu import CellularSpace, Diffusion, Model
+    from mpi_model_tpu.ensemble import AsyncEnsembleService
+
+    v = jnp.asarray(np.linspace(0.5, 2.0, 64).reshape(8, 8), jnp.float64)
+    space = CellularSpace.create(8, 8, 1.0, dtype=jnp.float64).with_values(
+        {"value": v})
+    model = Model(Diffusion(0.1), time=4.0, time_step=1.0)
+    with lockdep.armed(allowed=static_lock_graph()) as w:
+        svc = AsyncEnsembleService(model, steps=4, start=False)
+        t = svc.submit(space)
+        while svc.pump_once(force=True):
+            pass
+        assert svc.poll(t) is not None
+        svc.stop()
+    assert w.edges, "the witness saw no acquisitions at all"
+    w.assert_clean()
+
+
+def test_step_jaxpr_unchanged_with_lockdep_armed():
+    """Locks are host-side only: arming the witness cannot perturb a
+    traced step — the auditor-golden twin of the inject.py contract."""
+    from mpi_model_tpu import CellularSpace, Diffusion, Model
+
+    space = CellularSpace.create(8, 8, 1.0, dtype=jnp.float64)
+    sds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+           for k, v in space.values.items()}
+    clean = str(jax.make_jaxpr(
+        Model(Diffusion(0.1), 4.0, 1.0).make_step(space))(sds))
+    with lockdep.armed():
+        armed_jaxpr = str(jax.make_jaxpr(
+            Model(Diffusion(0.1), 4.0, 1.0).make_step(space))(sds))
+    assert armed_jaxpr == clean
