@@ -65,6 +65,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
 
 RATE = 0.1
@@ -516,6 +517,7 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
                   deadline_s: Optional[float] = None,
                   max_queue: int = 256, windows: int = 2,
                   chaos: bool = True, services: int = 1,
+                  transport: str = "inproc",
                   verbose: bool = False) -> dict:
     """Always-on serving soak (ISSUE 9): an open-loop arrival process
     drives ``n_scenarios`` scenarios through the async dispatch loop
@@ -545,7 +547,20 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
     a journaled fleet is hard-abandoned mid-run (a simulated process
     kill), ``FleetSupervisor.recover`` replays the journal, and the
     replay audit must show every submitted ticket resolved exactly
-    once (``recovery_ok``)."""
+    once (``recovery_ok``).
+
+    ``transport="process"`` (ISSUE 13 / BENCH_FLEET_r02) runs the
+    fleet with REAL spawned member processes behind the wire protocol.
+    The chaos plan swaps the in-process member faults for the wire
+    seams — including ``proc_kill``: an actual ``SIGKILL`` delivered
+    to a member process MID-SOAK. The supervisor must fence the dead
+    member (missed heartbeats / dead wire), respawn it as gen+1 and
+    recover its tickets; the soak is journaled and the standalone
+    ``audit_journal`` exactly-once audit must pass
+    (``kill9_audit_ok``), on top of the PR 10 abandon-and-recover leg
+    which also runs with process members. The bitwise preamble gate
+    (process-served == the inproc synchronous scheduler) is the
+    process-mode-equals-inproc acceptance check."""
     import numpy as np
     import jax.numpy as jnp
 
@@ -558,6 +573,12 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
 
     if services < 1:
         raise ValueError(f"services={services} must be >= 1")
+    if transport not in ("inproc", "process"):
+        raise ValueError(f"unknown transport {transport!r}")
+    if transport == "process" and services < 2:
+        raise ValueError(
+            "transport='process' is the fleet row — run it with "
+            "services >= 2 (--serve-services)")
 
     enable_compile_cache()
     dtype = jnp.dtype(dtype_name)
@@ -615,35 +636,56 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
     # -- the async soak, chaos armed: transient + loop-level faults
     # spread through the run; every one must resolve to a counted
     # outcome (recovered / quarantined / shed / expired)
-    faults = [
-        Fault("lane_nan", ticket=max(1, n_scenarios // 3), once=True),
-        Fault("batch_exc", at=max(2, n_scenarios // (2 * B))),
-        Fault("thread_exc", at=3),
-        Fault("slow_compile", at=5, seconds=0.01),
-        Fault("fetch_nan", at=max(3, n_scenarios // (2 * B)) + 4,
-              lane=0, once=True),
-        Fault("queue_full", at=max(4, n_scenarios // 2)),
-    ]
-    if services > 1:
-        # fleet mode: one member's pump thread dies MID-soak — the
-        # `at` threshold holds the (channel-unpinned) kill back until
-        # the fleet has pumped enough to be under real load, so the
-        # fencing path runs with tickets actually at stake; the
-        # supervisor must fence + restart it with the stream live
-        faults.append(Fault("member_kill",
-                            at=max(10, n_scenarios // 2)))
+    if transport == "process":
+        # ISSUE 13: member faults cannot fire inside a real child (the
+        # chaos plan is armed in THIS process) — the wire seams are the
+        # process fleet's fault surface, and proc_kill is a REAL
+        # SIGKILL delivered to a member process mid-soak
+        faults = [
+            Fault("heartbeat_loss", at=max(8, n_scenarios // 4)),
+            Fault("wire_torn", at=max(12, n_scenarios // 3),
+                  offset=4, nbytes=8, tear="corrupt"),
+            Fault("proc_kill", at=max(20, n_scenarios // 2)),
+        ]
+    else:
+        faults = [
+            Fault("lane_nan", ticket=max(1, n_scenarios // 3), once=True),
+            Fault("batch_exc", at=max(2, n_scenarios // (2 * B))),
+            Fault("thread_exc", at=3),
+            Fault("slow_compile", at=5, seconds=0.01),
+            Fault("fetch_nan", at=max(3, n_scenarios // (2 * B)) + 4,
+                  lane=0, once=True),
+            Fault("queue_full", at=max(4, n_scenarios // 2)),
+        ]
+        if services > 1:
+            # fleet mode: one member's pump thread dies MID-soak — the
+            # `at` threshold holds the (channel-unpinned) kill back
+            # until the fleet has pumped enough to be under real load,
+            # so the fencing path runs with tickets actually at stake;
+            # the supervisor must fence + restart it with the stream
+            # live
+            faults.append(Fault("member_kill",
+                                at=max(10, n_scenarios // 2)))
     plan = FaultPlan(tuple(faults), seed=23) if chaos else FaultPlan(())
     if services > 1:
+        fleet_kw = dict(kwargs)
+        if transport == "process":
+            fleet_kw.update(member_transport="process")
         async_svc = FleetSupervisor(
             template, services=services, windows=windows,
             max_queue=max_queue, deadline_s=deadline_s,
-            tick_interval_s=0.01, **kwargs)
+            tick_interval_s=0.01, **fleet_kw)
     else:
         async_svc = AsyncEnsembleService(
             template, windows=windows, max_queue=max_queue,
             deadline_s=deadline_s, **kwargs)
     with armed(plan) as arm_state, async_svc:
         async_rep = run_soak(async_svc, scenarios, arrival_rate_hz=rate)
+        # capture the dispatch log BEFORE the context exit tears the
+        # fleet down: a wire member's log is an RPC, and a stopped
+        # process fleet has closed its connections
+        raw_log = (async_svc.dispatch_logs() if services > 1
+                   else list(async_svc.scheduler.dispatch_log))
     fired = [f["kind"] for f in arm_state.fired]
     if not async_rep["ledger_complete"]:
         raise AssertionError(
@@ -653,8 +695,6 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
             f"shed {async_rep['shed']} != offered {async_rep['offered']}")
     # donation honesty from the (bounded) dispatch log: every windowed
     # dispatch still in the log must have carried its state copy-free
-    raw_log = (async_svc.dispatch_logs() if services > 1
-               else list(async_svc.scheduler.dispatch_log))
     logged = [d for d in raw_log if "windows" in d]
     donation_ok = bool(logged) and all(
         d["donated_windows"] == d["windows"] for d in logged)
@@ -672,14 +712,97 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
 
         fleet_fields = {
             "services": services,
+            "transport": transport,
             "member_faults": async_rep["member_faults"],
             "readmitted": async_rep["readmitted"],
         }
+        if transport == "process":
+            # ISSUE 13 observability: the wire ledger of the soak
+            # fleet (per-member attribution rides async_rep["services"])
+            soak_st = async_svc.stats()
+            fleet_fields.update({k: soak_st[k] for k in (
+                "respawns", "heartbeats", "heartbeat_misses",
+                "wire_errors", "wire_bytes_in", "wire_bytes_out")})
+
+        # -- process-only: the REAL kill -9 leg (ISSUE 13 acceptance,
+        # BENCH_FLEET_r02) — a JOURNALED process fleet is serving k
+        # tickets when one spawned member is SIGKILLed mid-soak; the
+        # supervisor fences the dead wire / missed heartbeats,
+        # respawns gen+1 and re-admits, every ticket resolves, and the
+        # standalone journal audit proves exactly-once (no duplicate
+        # terminals, nothing unresolved)
+        if transport == "process":
+            from mpi_model_tpu.ensemble.journal import audit_journal
+
+            kdir = tempfile.mkdtemp(prefix="fleet-kill9-")
+            k9 = min(4 * B, 24)
+            kf = FleetSupervisor(template, services=services,
+                                 max_queue=max_queue, journal_dir=kdir,
+                                 tick_interval_s=0.01,
+                                 heartbeat_deadline_s=0.5,
+                                 member_transport="process", **kwargs)
+            kts = [kf.submit(pool_spaces[i % B],
+                             model=pool_models[i % B], steps=steps)
+                   for i in range(k9)]
+            stop_by = _t.monotonic() + 120.0
+            victim = None
+            while _t.monotonic() < stop_by and victim is None:
+                victim = next(
+                    (s for s in kf.stats()["services"]
+                     if s["pending"] > 0 and s.get("member_pid")),
+                    None)
+                if victim is None:
+                    _t.sleep(0.005)
+            if victim is None:
+                raise AssertionError(
+                    "kill -9 leg: no member ever held pending work")
+            os.kill(victim["member_pid"], signal.SIGKILL)
+            k9_served = 0
+            for t in kts:
+                try:
+                    kf.result(t, timeout=300)
+                    k9_served += 1
+                # analysis: ignore[broad-except] — per-ticket honesty:
+                # a counted failure is a ledger line, not a bench abort
+                except Exception:
+                    pass
+            k9_stats = kf.stats()
+            kf.stop()
+            k9_audit = audit_journal(journal_path(kdir))
+            kill9_ok = (k9_audit["ok"] and not k9_audit["unresolved"]
+                        and k9_stats["respawns"] >= 1
+                        and k9_stats["member_faults"] >= 1
+                        and k9_served == k9)
+            if not kill9_ok:
+                raise AssertionError(
+                    f"kill -9 leg failed: served {k9_served}/{k9}, "
+                    f"respawns={k9_stats['respawns']}, audit="
+                    f"{k9_audit}")
+            fleet_fields.update({
+                "kill9_tickets": k9,
+                "kill9_served": k9_served,
+                "kill9_victim": victim["service_id"],
+                "kill9_respawns": k9_stats["respawns"],
+                "kill9_readmitted": k9_stats["readmitted"],
+                "kill9_heartbeat_misses": k9_stats["heartbeat_misses"],
+                "kill9_wire_errors": k9_stats["wire_errors"],
+                "kill9_audit_ok": bool(k9_audit["ok"]),
+            })
+            if verbose:
+                print(f"  kill -9: {victim['service_id']} SIGKILLed "
+                      f"holding {victim['pending']} tickets; "
+                      f"{k9_served}/{k9} served, "
+                      f"{k9_stats['respawns']} respawn(s), audit OK",
+                      file=sys.stderr)
+
         rdir = tempfile.mkdtemp(prefix="fleet-journal-")
         k = min(4 * B, 32)
+        rkw = dict(kwargs)
+        if transport == "process":
+            rkw["member_transport"] = "process"
         rf = FleetSupervisor(template, services=services,
                              max_queue=max_queue, journal_dir=rdir,
-                             tick_interval_s=0.01, **kwargs)
+                             tick_interval_s=0.01, **rkw)
         rts = [rf.submit(pool_spaces[i % B], model=pool_models[i % B],
                          steps=steps) for i in range(k)]
         stop_by = _t.monotonic() + 120.0
@@ -689,7 +812,7 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
         rf.abandon()
         r2 = FleetSupervisor.recover(rdir, template, services=services,
                                      max_queue=max_queue,
-                                     tick_interval_s=0.01, **kwargs)
+                                     tick_interval_s=0.01, **rkw)
         rerun = r2.stats()["readmitted"]
         recovered_served = 0
         for t in rts:
@@ -1472,10 +1595,19 @@ if __name__ == "__main__":
             n_services = next(
                 (int(a.split("=", 1)[1]) for a in sys.argv
                  if a.startswith("--serve-services=")), 1)
+            # --serve-transport=process (ISSUE 13): real spawned
+            # member processes, wire chaos incl. a REAL kill -9 leg;
+            # persists as the round's BENCH_FLEET_r02 artifact
+            srv_transport = next(
+                (a.split("=", 1)[1] for a in sys.argv
+                 if a.startswith("--serve-transport=")), "inproc")
             result = bench_service(services=n_services,
+                                   transport=srv_transport,
                                    verbose="-v" in sys.argv)
             out_name = ("BENCH_SERVE_r01.json" if n_services == 1
-                        else "BENCH_FLEET_r01.json")
+                        else "BENCH_FLEET_r01.json"
+                        if srv_transport == "inproc"
+                        else "BENCH_FLEET_r02.json")
             with open(out_name, "w") as fh:
                 json.dump(result, fh, indent=2)
                 fh.write("\n")

@@ -601,6 +601,66 @@ def check_naked_save(ctx: ModuleCtx):
                 "reason")
 
 
+# -- raw-transport rule (ISSUE 13 satellite) ----------------------------------
+# The multi-process fleet's correctness rests on every byte that
+# crosses a process boundary flowing through the ensemble.wire codec:
+# CRC-framed, deadline-bounded, typed errors, chaos-seamed. A module
+# opening its own socket or spawning its own subprocess bypasses all
+# four — an unframed byte stream can hang the supervisor, and an
+# unmanaged child is a process the fleet can neither heartbeat nor
+# fence. This mirrors the naked-save boundary pattern: the codec
+# modules are the sanctioned boundary, everything else pragmas a
+# genuine low-level rig with its reason.
+
+#: constructor/spawn entry points of the two transport modules
+_SUBPROCESS_CALLS = {"Popen", "run", "call", "check_call", "check_output"}
+_SOCKET_CALLS = {"socket", "socketpair", "create_connection",
+                 "create_server"}
+#: bare names that unambiguously mean a transport was opened even
+#: through a from-import ("run"/"call"/"socket" alone are too generic)
+_TRANSPORT_BARE = {"Popen", "socketpair", "create_connection",
+                   "create_server"}
+
+
+def _transport_boundary_module(ctx: ModuleCtx) -> bool:
+    """ensemble/wire.py and ensemble/member_proc.py are THE transport
+    boundary: the codec and the member spawn/serve machinery."""
+    parts = ctx.resolved_parts
+    return (len(parts) >= 2 and parts[-2] == "ensemble"
+            and parts[-1] in ("wire.py", "member_proc.py"))
+
+
+@rule("raw-transport", Severity.ERROR,
+      "raw socket/subprocess use outside the ensemble wire boundary — "
+      "bytes crossing a process edge must ride the CRC-framed, "
+      "deadline-bounded codec (ensemble/wire.py, member_proc.py)",
+      scope=SCOPE_PACKAGE)
+def check_raw_transport(ctx: ModuleCtx):
+    if _transport_boundary_module(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        hit = None
+        if isinstance(fn, ast.Attribute):
+            recv = _dotted_last(fn.value)
+            if recv == "subprocess" and fn.attr in _SUBPROCESS_CALLS:
+                hit = f"subprocess.{fn.attr}"
+            elif recv == "socket" and fn.attr in _SOCKET_CALLS:
+                hit = f"socket.{fn.attr}"
+        elif isinstance(fn, ast.Name) and fn.id in _TRANSPORT_BARE:
+            hit = fn.id
+        if hit is not None:
+            yield Finding(
+                "raw-transport", Severity.ERROR, ctx.path, node.lineno,
+                f"raw `{hit}(...)` outside the wire boundary — route "
+                "process/socket traffic through ensemble.wire/"
+                "member_proc (CRC framing, RPC deadlines, chaos "
+                "seams), or pragma a genuine low-level rig with its "
+                "reason")
+
+
 # -- unguarded-shared-mutation rule (ISSUE 9 satellite) -----------------------
 # The ensemble scheduler/service now run submit/poll on client threads
 # while a pump thread dispatches: every class that owns a dispatch lock

@@ -347,8 +347,13 @@ def _run_serve(args, space, model) -> int:
         max_queue=args.max_queue, compute_dtype=_compute_dtype(args),
         deadline_s=args.deadline_s, retry="solo",
         compile_cache=_cache_spec(args, "auto"))
-    if args.serve_services > 1:
+    fleet_mode = (args.serve_services > 1
+                  or args.serve_transport != "inproc")
+    if fleet_mode:
+        # process transport always runs under the fleet supervisor —
+        # someone must heartbeat, fence and respawn the children
         svc = FleetSupervisor(model, services=args.serve_services,
+                              member_transport=args.serve_transport,
                               **svc_kw)
     else:
         svc = AsyncEnsembleService(model, **svc_kw)
@@ -363,6 +368,7 @@ def _run_serve(args, space, model) -> int:
         "max_queue": args.max_queue,
         "deadline_s": args.deadline_s,
         "services": args.serve_services,
+        "transport": args.serve_transport,
         **{k: rep[k] for k in (
             "offered", "served", "failed", "expired", "shed",
             "ledger_complete", "wall_s", "sustained_scenarios_per_s",
@@ -370,15 +376,21 @@ def _run_serve(args, space, model) -> int:
             "batch_occupancy", "dispatches", "solo_retries",
             "recovered_failures", "quarantined", "loop_faults")},
     }
-    if args.serve_services > 1:
+    if fleet_mode:
         result["member_faults"] = rep["member_faults"]
         result["readmitted"] = rep["readmitted"]
         # per-member attribution (the service_id satellite): enough for
-        # an operator to see which member served what
+        # an operator to see which member served what; process
+        # transport adds the wire observability (ISSUE 13)
         result["members"] = [
             {k: s[k] for k in ("service_id", "scenarios", "dispatches",
                                "pending", "gen")}
             for s in rep["services"]]
+        if args.serve_transport == "process":
+            st = svc.stats()
+            for k in ("respawns", "heartbeats", "heartbeat_misses",
+                      "wire_errors", "wire_bytes_in", "wire_bytes_out"):
+                result[k] = st[k]
     if args.json:
         print(json.dumps(result, allow_nan=False))
     else:
@@ -533,7 +545,8 @@ def cmd_run(args) -> int:
                 ("--deadline-s", args.deadline_s, None),
                 ("--max-queue", args.max_queue, 64),
                 ("--serve-scenarios", args.serve_scenarios, 64),
-                ("--serve-services", args.serve_services, 1)):
+                ("--serve-services", args.serve_services, 1),
+                ("--serve-transport", args.serve_transport, "inproc")):
             if val != default:
                 raise SystemExit(
                     f"{flag} configures the always-on serving loop; "
@@ -887,6 +900,15 @@ def main(argv: Optional[list[str]] = None) -> int:
                      "structure-affine routing, member fencing + "
                      "restart, per-member attribution); default 1 = "
                      "the single async loop")
+    run.add_argument("--serve-transport", default="inproc",
+                     choices=("inproc", "process"),
+                     help="fleet member transport (ISSUE 13): "
+                     "'inproc' (default) runs members as in-process "
+                     "services; 'process' spawns each member as its "
+                     "own OS process behind the CRC-framed wire "
+                     "protocol (heartbeat health, fence + respawn on "
+                     "a killed member, per-member device pinning via "
+                     "the child environment)")
     run.add_argument("--arrival-rate", type=float, default=None,
                      metavar="HZ",
                      help="open-loop arrival rate in scenarios/s "

@@ -20,7 +20,17 @@ compile cache.
                   isolation (fence + restart + re-admit) and
                   crash-restart ticket recovery;
 - ``journal``   — the append-only CRC'd ticket journal behind
-                  ``FleetSupervisor.recover``.
+                  ``FleetSupervisor.recover``, also a standalone
+                  inspection CLI (``python -m
+                  mpi_model_tpu.ensemble.journal <dir>``);
+- ``wire``      — the TJ1 record format promoted to a socket codec
+                  (ISSUE 13): length-prefixed CRC-framed messages,
+                  typed errors, per-RPC deadlines;
+- ``member_proc`` — fleet members as separate OS processes behind the
+                  wire protocol (``FleetSupervisor(member_transport=
+                  "process")``): worker entrypoint, supervisor-side
+                  client proxy, real-process and in-memory-loopback
+                  spawners.
 
 See docs/DESIGN.md "Ensemble serving" / "Always-on serving" / "Fleet
 supervision" for why the batch axis sits OUTSIDE the mesh axes and how
@@ -44,6 +54,7 @@ from .scheduler import (DEFAULT_BUCKETS, DispatchTimeout,
                         TicketNotMigratable, buckets_for)
 from .service import (AsyncEnsembleService, EnsembleService,
                       ServiceOverloaded, run_soak)
+from .wire import FrameConn, RemoteError, WireClosed, WireError, WireTimeout
 
 __all__ = [
     "AsyncEnsembleService",
@@ -61,6 +72,11 @@ __all__ = [
     "EnsembleSpace",
     "ServiceOverloaded",
     "TicketExpired",
+    "FrameConn",
+    "RemoteError",
+    "WireClosed",
+    "WireError",
+    "WireTimeout",
     "DEFAULT_BUCKETS",
     "buckets_for",
     "complete_ensemble",

@@ -76,6 +76,7 @@ from .journal import (TicketJournal, journal_path, model_from_meta,
                       model_meta, replay, space_from_record, space_payload)
 from .scheduler import TicketExpired, TicketNotMigratable
 from .service import AsyncEnsembleService, ServiceOverloaded
+from .wire import WireError
 
 __all__ = ["AutoscalePolicy", "FleetSupervisor", "MemberFailure"]
 
@@ -180,6 +181,11 @@ class FleetSupervisor:
                  clock: Callable[[], float] = time.monotonic,
                  start: bool = True,
                  poll_interval_s: float = 0.02,
+                 member_transport: str = "inproc",
+                 member_spawner: Optional[Callable] = None,
+                 heartbeat_deadline_s: float = 2.0,
+                 rpc_deadline_s: float = 30.0,
+                 member_env: Optional[dict] = None,
                  **member_kwargs):
         if services < 1:
             raise ValueError(f"services={services} must be >= 1")
@@ -187,6 +193,36 @@ class FleetSupervisor:
             raise ValueError(
                 f"services={services} exceeds the policy's max_services="
                 f"{policy.max_services}")
+        if member_transport not in ("inproc", "process"):
+            raise ValueError(
+                f"unknown member_transport {member_transport!r} "
+                "(expected 'inproc' or 'process')")
+        #: ISSUE 13: "inproc" (the default — in-process
+        #: AsyncEnsembleService members, behaviorally identical to
+        #: PR 10) or "process" — members behind the ensemble.wire
+        #: protocol, spawned by ``member_spawner`` (default: real OS
+        #: processes via member_proc.spawn_process_member; tests pass
+        #: spawn_loopback_member for the zero-subprocess fake). Health
+        #: rides heartbeats (missed past ``heartbeat_deadline_s`` on
+        #: the injectable clock → fence + respawn gen+1); every RPC is
+        #: bounded by ``rpc_deadline_s`` and a wire failure is a
+        #: MEMBER fault, never a ticket outcome. ``member_env`` is the
+        #: device-pinning env contract laid over each spawned child.
+        self._transport = member_transport
+        self._heartbeat_deadline = float(heartbeat_deadline_s)
+        self._rpc_deadline = float(rpc_deadline_s)
+        self._member_env = member_env
+        self._spawner = member_spawner
+        if member_transport == "process":
+            if self._spawner is None:
+                from .member_proc import spawn_process_member
+
+                self._spawner = spawn_process_member
+            if model_meta(model) is None:
+                raise ValueError(
+                    "member_transport='process' needs a template model "
+                    "model_meta() can serialize (scalar-field flows); "
+                    "this model has no wire recipe")
         self.model = model
         self.default_steps = (int(member_kwargs["steps"])
                               if member_kwargs.get("steps") is not None
@@ -254,9 +290,27 @@ class FleetSupervisor:
 
     def _spawn_locked(self, slot: int, gen: int) -> _Member:
         sid = f"m{slot}g{gen}"
-        svc = AsyncEnsembleService(self.model, service_id=sid,
-                                   start=self._threaded,
-                                   **self._member_kwargs)
+        if self._transport == "inproc":
+            svc = AsyncEnsembleService(self.model, service_id=sid,
+                                       start=self._threaded,
+                                       **self._member_kwargs)
+        else:
+            # a wire-backed member: the spawner owns the transport
+            # (real child process, or the loopback serve thread); the
+            # member pumps itself when the fleet is threaded and is
+            # pumped over the wire in manual mode
+            svc = self._spawner(
+                self.model, service_id=sid,
+                member_kwargs=dict(self._member_kwargs),
+                clock=self._clock,
+                heartbeat_deadline_s=self._heartbeat_deadline,
+                rpc_deadline_s=self._rpc_deadline,
+                member_env=self._member_env,
+                pump_mode="thread" if self._threaded else "rpc")
+        if gen > 0:
+            # observability: how many times this fleet replaced a
+            # member in place (fence → gen+1)
+            self.counter.bump("respawns")
         m = _Member(service=svc, slot=slot, gen=gen,
                     progress_t=self._clock())
         self._members[slot] = m
@@ -284,6 +338,16 @@ class FleetSupervisor:
             self._stopped = True
             if self.journal is not None:
                 self.journal.close()
+            remaining = list(self._members.values())
+        if self._transport != "inproc":
+            # wire teardown AFTER the final harvest: the drain RPC in
+            # stop() above kept each member's connection open so the
+            # last tick could still poll results across it
+            for m in remaining:
+                try:
+                    m.service.close()
+                except WireError:  # pragma: no cover - best effort
+                    pass
 
     def abandon(self) -> None:
         """Walk away WITHOUT draining — the crash simulation used by the
@@ -352,10 +416,17 @@ class FleetSupervisor:
                     # analysis: ignore[blocking-under-lock] — admission
                     # routing must be atomic with the route table, and
                     # members run inline_dispatch=False: their submit
-                    # is depth-check + enqueue, never device work
+                    # is depth-check + enqueue, never device work (a
+                    # wire member's submit RPC is deadline-bounded)
                     mt = mem.service.submit(space, model=model, steps=n)
                 except ServiceOverloaded as e:
                     last = e
+                    continue
+                except WireError:
+                    # the member's wire died under us: a member fault —
+                    # mark dead (next tick fences), try the next one
+                    self.counter.bump("wire_errors")
+                    mem.dead = True
                     continue
                 ticket = next(self._ids)
                 route = _Route(member=mem, member_ticket=mt, space=space,
@@ -416,6 +487,12 @@ class FleetSupervisor:
                     # table (the pump thread owns dispatching), so the
                     # statically-visible dispatch chain never runs here
                     r = route.member.service.poll(route.member_ticket)
+                except WireError:
+                    # member fault, not a ticket outcome: the next
+                    # tick fences the member and re-admits this ticket
+                    self.counter.bump("wire_errors")
+                    route.member.dead = True
+                    return None
                 # analysis: ignore[broad-except] — harvest seam: ANY
                 # per-ticket resolution error (quarantine, expiry,
                 # conservation, dispatch fault) must be journaled and
@@ -475,6 +552,13 @@ class FleetSupervisor:
                 with self._cv:
                     m.dead = True
                 did = True
+            except WireError:
+                # the member's wire died mid-pump: a member fault —
+                # dead now, fenced by this pump's tick
+                self.counter.bump("wire_errors")
+                with self._cv:
+                    m.dead = True
+                did = True
             # analysis: ignore[broad-except] — the manual-mode pump
             # supervisor mirrors AsyncEnsembleService._loop: a pump
             # fault is counted and survived, never fatal to the fleet
@@ -497,7 +581,13 @@ class FleetSupervisor:
         right that a join under the fleet lock would stall every
         submit/poll for the duration of the drain. By removal time the
         member holds no routes and takes no intake, so nothing can race
-        its shutdown."""
+        its shutdown.
+
+        Wire transports add a phase BEFORE the lock: every live member
+        is heartbeat-RPCed (deadline-bounded, outside the fleet lock —
+        a slow wire must not stall submit/poll), refreshing the cached
+        telemetry the locked phase then reads."""
+        self._heartbeat_members()
         with self._cv:
             if self._abandoned:
                 return  # a simulated kill: supervision is dead
@@ -518,6 +608,26 @@ class FleetSupervisor:
             except Exception:
                 self.counter.bump("loop_faults")
 
+    def _heartbeat_members(self) -> None:
+        """The wire transports' liveness phase (inproc: no-op): beat
+        every live member OUTSIDE the fleet lock (the RPC is
+        deadline-bounded, but even a bounded stall must not hold
+        submit/poll), refreshing the per-member telemetry cut. Misses
+        are counted; ``is_alive`` ages them against
+        ``heartbeat_deadline_s`` on the injectable clock and the
+        health check fences what went stale."""
+        if self._transport == "inproc":
+            return
+        with self._cv:
+            if self._abandoned or self._stopped:
+                return
+            members = [m for m in self._members.values()
+                       if not m.fenced and not m.dead]
+        for m in members:
+            self.counter.bump("heartbeats")
+            if not m.service.heartbeat():
+                self.counter.bump("heartbeat_misses")
+
     def _harvest_locked(self) -> None:
         for ticket, route in list(self._route.items()):
             m = route.member
@@ -528,6 +638,13 @@ class FleetSupervisor:
                 # runs pump=False: results-table check only, the
                 # dispatch chain the auditor sees is the pump's
                 r = m.service.poll(route.member_ticket)
+            except WireError:
+                # a broken wire is a MEMBER fault, not a ticket
+                # outcome: mark the member dead — this same tick's
+                # health check fences it and re-admits its tickets
+                self.counter.bump("wire_errors")
+                m.dead = True
+                continue
             # analysis: ignore[broad-except] — harvest seam (see poll)
             except Exception as e:
                 self._finalize_locked(ticket, e)
@@ -585,7 +702,11 @@ class FleetSupervisor:
                 self._journal_append_locked(kind, {
                     "ticket": ticket, "service_id": sid,
                     "steps": route.steps,
-                    "error": type(outcome).__name__,
+                    # a wire-crossed error journals its ORIGINAL
+                    # member-side class (RemoteError.remote_type), so
+                    # the ledger reads the same in both transports
+                    "error": getattr(outcome, "remote_type",
+                                     type(outcome).__name__),
                     "detail": str(outcome)})
             elif self.journal is not None:
                 space, report = outcome
@@ -644,8 +765,19 @@ class FleetSupervisor:
                 m.progress_t = now
             pending = m.service.scheduler.pending_count()
             reason = None
-            if m.dead or (self._threaded and not self._stop_flag
-                          and not m.service.is_alive()):
+            if m.dead:
+                reason = "pump thread died"
+            elif (self._transport != "inproc" and not self._stop_flag
+                  and not m.service.is_alive()):
+                # wire members: liveness IS heartbeat freshness (there
+                # is no thread to probe across a process boundary) —
+                # checked in manual AND threaded fleets
+                reason = ("missed heartbeats: last good beat "
+                          f"{m.service.heartbeat_age():.3f}s ago "
+                          "(heartbeat deadline "
+                          f"{self._heartbeat_deadline}s)")
+            elif (self._transport == "inproc" and self._threaded
+                  and not self._stop_flag and not m.service.is_alive()):
                 reason = "pump thread died"
             elif (pending > 0 and due
                   and now - m.progress_t > self._supervision_deadline):
@@ -678,6 +810,10 @@ class FleetSupervisor:
         c = m.service.scheduler.counter
         for k in self._ABSORB_KEYS:
             self._absorbed[k] = self._absorbed.get(k, 0) + getattr(c, k)
+        for k in ("wire_bytes_in", "wire_bytes_out"):
+            v = getattr(m.service, k, None)
+            if v is not None:
+                self._absorbed[k] = self._absorbed.get(k, 0) + int(v)
 
     def _member_event_locked(self, m: _Member, reason: str) -> None:
         from ..resilience import FailureEvent
@@ -741,6 +877,13 @@ class FleetSupervisor:
                 # analysis: ignore[blocking-under-lock] — member poll
                 # runs pump=False (results-table check only)
                 r = m.service.poll(route.member_ticket)
+            except WireError:
+                # the fenced member's wire is gone (a killed process):
+                # nothing to harvest or migrate — re-admit from the
+                # fleet's stored state
+                self.counter.bump("wire_errors")
+                self._readmit_locked(ticket, route, reason)
+                continue
             # analysis: ignore[broad-except] — harvest seam (see poll)
             except Exception as e:
                 self._finalize_locked(ticket, e)
@@ -763,6 +906,17 @@ class FleetSupervisor:
                         route.member_ticket, target.service.scheduler)
                 except (TicketNotMigratable, KeyError):
                     pass  # claimed/launched — re-admit from stored state
+                except WireError:
+                    # dead wire — re-admit from stored state
+                    self.counter.bump("wire_errors")
+                # analysis: ignore[broad-except] — fence-drain
+                # isolation: a wire-crossed migrate can surface ANY
+                # member-side error (RemoteError, a reconstructed
+                # expiry); unwinding would strand the fenced member's
+                # remaining tickets (fenced members are never
+                # revisited) — the fleet's stored copy re-admits
+                except Exception:
+                    self.counter.bump("loop_faults")
                 else:
                     route.member, route.member_ticket = target, new_mt
                     moved = True
@@ -782,24 +936,30 @@ class FleetSupervisor:
         old_sid = (route.member.service_id if route.member is not None
                    else "recovery")
         skey = structure_key(route.model, route.space) + (route.steps,)
-        order = self._candidates_locked(skey)
-        if not order:
-            self._finalize_locked(ticket, MemberFailure(
-                f"member {old_sid} failed ({reason}) and no healthy "
-                f"member remains to re-admit ticket {ticket}", old_sid))
+        for target in self._candidates_locked(skey):
+            try:
+                # analysis: ignore[blocking-under-lock] — re-admission
+                # must be atomic with the route table, and members run
+                # inline_dispatch=False: the scheduler's inline-dispatch
+                # tail the auditor sees is unreachable on this path
+                new_mt = target.service.scheduler.submit(
+                    route.space, route.model, route.steps)
+            except WireError:
+                # a rescue target whose own wire is dead: mark it (its
+                # fencing is the next health check's) and try the next
+                # candidate — a re-admission must never strand mid-fence
+                self.counter.bump("wire_errors")
+                target.dead = True
+                continue
+            route.member, route.member_ticket = target, new_mt
+            self.counter.bump("readmitted")
+            self._journal_append_locked("readmit", {
+                "ticket": ticket, "from": old_sid,
+                "to": target.service_id, "reason": reason})
             return
-        target = order[0]
-        # analysis: ignore[blocking-under-lock] — re-admission must be
-        # atomic with the route table, and members run
-        # inline_dispatch=False: the scheduler's inline-dispatch tail
-        # the auditor sees is unreachable on this path
-        new_mt = target.service.scheduler.submit(
-            route.space, route.model, route.steps)
-        route.member, route.member_ticket = target, new_mt
-        self.counter.bump("readmitted")
-        self._journal_append_locked("readmit", {
-            "ticket": ticket, "from": old_sid,
-            "to": target.service_id, "reason": reason})
+        self._finalize_locked(ticket, MemberFailure(
+            f"member {old_sid} failed ({reason}) and no healthy "
+            f"member remains to re-admit ticket {ticket}", old_sid))
 
     def _advance_retirements_locked(self) -> list[_Member]:
         """Advance every drain-before-retire: migrate queued tickets
@@ -830,7 +990,15 @@ class FleetSupervisor:
         """Move every still-QUEUED ticket off ``m`` (drain-before-
         retire / fencing); claimed/launched tickets are left to resolve
         in place (retire) or re-admitted (fencing path)."""
-        for mt in m.service.scheduler.queued_tickets():
+        try:
+            queued = m.service.scheduler.queued_tickets()
+        except WireError:
+            # the retiree's wire died mid-drain: a member fault — dead
+            # now; the fencing path re-admits what it held
+            self.counter.bump("wire_errors")
+            m.dead = True
+            return
+        for mt in queued:
             ticket = next((t for t, r in self._route.items()
                            if r.member is m and r.member_ticket == mt),
                           None)
@@ -849,6 +1017,23 @@ class FleetSupervisor:
                 new_mt = m.service.scheduler.migrate_ticket(
                     mt, order[0].service.scheduler)
             except (TicketNotMigratable, KeyError):
+                continue
+            except WireError:
+                # either side's wire died mid-move (extract done,
+                # landing unknown): the fleet's own copy of the
+                # scenario is the one source that is still certain —
+                # re-admit from it now; whichever side actually died
+                # is fenced by its missed heartbeats
+                self.counter.bump("wire_errors")
+                self._readmit_locked(ticket, route, reason)
+                continue
+            # analysis: ignore[broad-except] — same mid-move shape for
+            # any OTHER wire-crossed member error (RemoteError …): the
+            # extract may have landed, so the route must not keep
+            # pointing at the source — re-admit from the stored copy
+            except Exception:
+                self.counter.bump("loop_faults")
+                self._readmit_locked(ticket, route, reason)
                 continue
             route.member, route.member_ticket = order[0], new_mt
             self._journal_append_locked("migrate", {
@@ -1002,10 +1187,20 @@ class FleetSupervisor:
     def dispatch_logs(self) -> list:
         """Recent dispatch-log entries across the CURRENT members
         (fenced members' logs die with them) — the bench's donation
-        audit reads this; it is a debugging window, not a ledger."""
+        audit reads this; it is a debugging window, not a ledger.
+        Gathered OUTSIDE the fleet lock: a wire member's log is an
+        RPC, and a debugging window must never stall submit/poll."""
         with self._cv:
-            return [dict(e) for m in self._members.values()
-                    for e in m.service.scheduler.dispatch_log]
+            members = [m for m in self._members.values()
+                       if not m.dead and not m.fenced]
+        out = []
+        for m in members:
+            try:
+                out.extend(dict(e)
+                           for e in m.service.scheduler.dispatch_log)
+            except WireError:  # pragma: no cover - debugging window
+                self.counter.bump("wire_errors")
+        return out
 
     def stats(self) -> dict:
         """One consistent fleet-level cut: member counters aggregated,
@@ -1029,9 +1224,15 @@ class FleetSupervisor:
             # — the work a member did before dying still counts
             busy = float(self._absorbed.get("busy_s", 0.0))
             inflight = float(self._absorbed.get("inflight_s", 0.0))
+            wire_in = int(self._absorbed.get("wire_bytes_in", 0))
+            wire_out = int(self._absorbed.get("wire_bytes_out", 0))
             for k in agg:
                 agg[k] += self._absorbed.get(k, 0)
             for m in members:
+                wire_in += int(getattr(m.service, "wire_bytes_in",
+                                       0) or 0)
+                wire_out += int(getattr(m.service, "wire_bytes_out",
+                                        0) or 0)
                 # plain counter reads (GIL-atomic ints/floats): the
                 # aggregate is a statistical cut, not a transaction
                 c = m.service.scheduler.counter
@@ -1067,6 +1268,15 @@ class FleetSupervisor:
                 "readmitted": snap["readmitted"],
                 "scale_ups": snap["scale_ups"],
                 "scale_downs": snap["scale_downs"],
+                # ISSUE 13 observability: the wire transport's ledger
+                # (all zero for inproc fleets)
+                "member_transport": self._transport,
+                "respawns": snap["respawns"],
+                "heartbeats": snap["heartbeats"],
+                "heartbeat_misses": snap["heartbeat_misses"],
+                "wire_errors": snap["wire_errors"],
+                "wire_bytes_in": wire_in,
+                "wire_bytes_out": wire_out,
                 "pending": len(self._route),
                 "degraded_from": degraded_from,
                 "intake_gated": gated,

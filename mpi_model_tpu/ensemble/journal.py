@@ -63,8 +63,12 @@ import numpy as np
 from ..core.cellular_space import CellularSpace
 from ..models.model import Model
 from ..resilience import inject
+from .wire import encode_payload, parse_payload
 
 __all__ = [
+    "audit_journal",
+    "fold_records",
+    "main",
     "TicketJournal",
     "JournalRecord",
     "JournalState",
@@ -143,24 +147,10 @@ class TicketJournal:
         a real mid-record crash would."""
         body = dict(meta or {})
         body["kind"] = kind
-        blob = b""
-        if arrays is not None:
-            table = {}
-            parts = []
-            off = 0
-            for name in sorted(arrays):
-                a = np.ascontiguousarray(np.asarray(arrays[name]))
-                raw = a.tobytes()
-                table[name] = {
-                    "dtype": str(a.dtype), "shape": list(a.shape),
-                    "offset": off, "nbytes": len(raw),
-                    "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
-                }
-                parts.append(raw)
-                off += len(raw)
-            body["arrays"] = table
-            blob = b"\x00" + b"".join(parts)
-        payload = json.dumps(body, sort_keys=True).encode() + blob
+        # ONE payload format for the journal and the fleet wire
+        # (ISSUE 13 lifted it into ensemble.wire): a journal record and
+        # a wire message differ only in their envelope
+        payload = encode_payload(body, arrays)
         header = b"TJ1 %08x %08x\n" % (
             len(payload), zlib.crc32(payload) & 0xFFFFFFFF)
         start = self._fh.tell()
@@ -183,25 +173,10 @@ class TicketJournal:
 
 
 def _parse_record(index: int, payload: bytes) -> JournalRecord:
-    cut = payload.find(b"\x00")
-    meta_bytes = payload if cut < 0 else payload[:cut]
-    meta = json.loads(meta_bytes.decode())
-    arrays = None
-    if "arrays" in meta:
-        if cut < 0:
-            raise ValueError("record declares arrays but carries no blob")
-        blob = payload[cut + 1:]
-        arrays = {}
-        for name, spec in meta["arrays"].items():
-            raw = blob[spec["offset"]:spec["offset"] + spec["nbytes"]]
-            if len(raw) != spec["nbytes"]:
-                raise ValueError(f"array {name!r} blob slice short")
-            if (zlib.crc32(raw) & 0xFFFFFFFF) != spec["crc32"]:
-                raise ValueError(
-                    f"array {name!r} failed its per-array CRC32")
-            arrays[name] = np.frombuffer(
-                raw, dtype=np.dtype(spec["dtype"])
-            ).reshape(tuple(spec["shape"])).copy()
+    # the shared TJ1/TW1 payload codec verifies every per-array CRC32;
+    # WireError is a ValueError, so _scan's truncate-to-verified-prefix
+    # catch treats a malformed payload exactly like a torn one
+    meta, arrays = parse_payload(payload)
     return JournalRecord(index, meta["kind"], meta, arrays)
 
 
@@ -270,6 +245,14 @@ class JournalState:
 
 def replay(path: str) -> JournalState:
     records, torn = read_records(path)
+    return fold_records(records, torn)
+
+
+def fold_records(records: list, torn: bool) -> JournalState:
+    """Fold already-verified records to per-ticket outcomes — the
+    in-memory half of :func:`replay`, so callers that already hold the
+    record list (the inspection CLI) do not re-read and re-CRC the
+    whole file per derived view."""
     submits: dict = {}
     terminal: dict = {}
     dup: list = []
@@ -360,3 +343,96 @@ def model_from_meta(meta: Optional[dict], template=None):
         flows.append(cls(**params))
     return Model(flows, meta["time"], meta["time_step"],
                  offsets=[tuple(o) for o in meta["offsets"]])
+
+
+# -- inspection CLI (ISSUE 13 satellite) --------------------------------------
+
+def audit_journal(path: str, _records: Optional[list] = None,
+                  _torn: Optional[bool] = None) -> dict:
+    """The exactly-once audit as one reusable cut (the CLI below and
+    the bench's recovery leg share it): verified record counts per
+    kind, the torn flag, the unresolved-ticket list and the
+    duplicate-terminal list. ``ok`` is the exactly-once verdict —
+    no ticket resolved twice (unresolved tickets are a RECOVERY TODO,
+    not an audit failure: they are exactly what ``recover`` re-admits).
+    A caller that already scanned the file passes the verified records
+    through ``_records``/``_torn`` — the file is read and CRC-checked
+    exactly once per invocation either way."""
+    if _records is None:
+        records, torn = read_records(path)
+    else:
+        records, torn = _records, bool(_torn)
+    state = fold_records(records, torn)
+    kinds: dict = {}
+    for rec in records:
+        kinds[rec.kind] = kinds.get(rec.kind, 0) + 1
+    return {
+        "path": path,
+        "records": len(records),
+        "kinds": kinds,
+        "torn": torn,
+        "submits": len(state.submits),
+        "terminal": len(state.terminal),
+        "shed": state.shed,
+        "unresolved": state.unresolved(),
+        "duplicate_terminals": list(state.duplicate_terminals),
+        "ok": not state.duplicate_terminals,
+    }
+
+
+def main(argv: Optional[list] = None) -> int:
+    """``python -m mpi_model_tpu.ensemble.journal <dir-or-file>``:
+    print the verified record stream (index, kind, ticket, byte sizes)
+    and run the ``replay()`` exactly-once audit standalone — the
+    operator's window into a crashed fleet's ledger before (or after)
+    ``FleetSupervisor.recover`` replays it. ``--json`` emits the audit
+    dict on one line; exit 1 when the audit finds duplicate terminals
+    (a ticket resolved twice — the invariant recovery must never
+    break), 0 otherwise (a torn tail or unresolved tickets are
+    REPORTED, not fatal: they are the normal crash shape)."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="python -m mpi_model_tpu.ensemble.journal",
+        description="Inspect a fleet ticket journal: verified record "
+                    "stream + the replay() exactly-once audit.")
+    p.add_argument("journal", help="journal directory (containing "
+                   f"{JOURNAL_NAME}) or the journal file itself")
+    p.add_argument("--json", action="store_true",
+                   help="emit the audit as one JSON line (no record "
+                        "listing)")
+    args = p.parse_args(argv)
+    path = args.journal
+    if os.path.isdir(path):
+        path = journal_path(path)
+    if not os.path.exists(path):
+        print(f"no journal at {path}", file=sys.stderr)
+        return 2
+    records, torn = read_records(path)  # ONE scan for every view below
+    audit = audit_journal(path, _records=records, _torn=torn)
+    if args.json:
+        print(json.dumps(audit, sort_keys=True))
+    else:
+        for rec in records:
+            nbytes = sum(spec["nbytes"] for spec in
+                         rec.meta.get("arrays", {}).values())
+            t = "" if rec.ticket is None else f" ticket={rec.ticket}"
+            extra = "" if nbytes == 0 else f" state={nbytes}B"
+            sid = rec.meta.get("service_id")
+            extra += "" if sid is None else f" member={sid}"
+            print(f"[{rec.index:4d}] {rec.kind:<12}{t}{extra}")
+        print(f"-- {audit['records']} verified records "
+              f"({', '.join(f'{k}={v}' for k, v in sorted(audit['kinds'].items()))})"
+              + ("; TORN TAIL discarded" if audit["torn"] else ""))
+        print(f"-- audit: submits={audit['submits']} "
+              f"terminal={audit['terminal']} shed={audit['shed']} "
+              f"unresolved={audit['unresolved']} "
+              f"duplicate_terminals={audit['duplicate_terminals']}")
+        print("-- exactly-once: " + ("OK" if audit["ok"] else
+                                     "FAILED (duplicate terminals)"))
+    return 0 if audit["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

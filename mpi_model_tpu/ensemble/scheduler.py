@@ -499,6 +499,29 @@ class EnsembleScheduler:
             raise ValueError(
                 "migrate_ticket needs a DIFFERENT target scheduler "
                 "(migrating onto oneself is a no-op with extra steps)")
+        space, model, steps = self.extract_ticket(ticket)
+        new_ticket = target.submit(space, model, steps)
+        with target._lock:
+            target.migrated_in += 1
+        with self._lock:
+            self.dispatch_log.append({
+                "migrated_ticket": ticket, "to_ticket": new_ticket,
+                "steps": steps,
+            })
+        return new_ticket
+
+    def extract_ticket(self, ticket: int
+                       ) -> tuple[CellularSpace, object, int]:
+        """Verify-then-drain one QUEUED scenario OUT of this scheduler:
+        ``(space, model, steps)`` with the state already passed through
+        the CRC-verified transfer (``io.delta.transfer_space``) — the
+        first half of :meth:`migrate_ticket`, exposed on its own so a
+        WIRE-backed migration (ISSUE 13: the source member serializes
+        the scenario, the supervisor resubmits it on another process's
+        scheduler) drains through the same verified path. Raises
+        ``KeyError`` for unknown/served tickets and
+        :class:`TicketNotMigratable` for claimed/launched ones; on any
+        failure the ticket stays queued here."""
         with self._lock:
             if ticket in self._results:
                 raise KeyError(
@@ -540,15 +563,7 @@ class EnsembleScheduler:
                 del self._queues[key]
             self._pending_tickets.discard(ticket)
             self.migrated_out += 1
-        new_ticket = target.submit(space, it.model, it.steps)
-        with target._lock:
-            target.migrated_in += 1
-        with self._lock:
-            self.dispatch_log.append({
-                "migrated_ticket": ticket, "to_ticket": new_ticket,
-                "steps": it.steps,
-            })
-        return new_ticket
+        return space, it.model, it.steps
 
     def flush_ticket(self, ticket: int) -> int:
         """Dispatch only the group holding ``ticket`` until that ticket

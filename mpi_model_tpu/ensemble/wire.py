@@ -1,0 +1,396 @@
+"""Wire protocol for fleet members — the TJ1 record format promoted to
+a message codec (ISSUE 13 tentpole, layer 1).
+
+The ticket journal already serializes every message the fleet
+exchanges: a ``submit`` record carries the full scenario state plus the
+model recipe, a ``served`` record carries the harvested state, both
+CRC-framed. This module lifts that format out of the journal file and
+onto a socket, so a fleet member can live in ANOTHER PROCESS (see
+``ensemble.member_proc``) while the supervisor keeps speaking the same
+payloads it journals.
+
+Frame format (the TJ1 discipline, distinct magic)::
+
+    b"TW1 <len:08x> <crc:08x>\\n" + payload + b"\\n"
+
+where the CRC32 covers the whole payload. A payload is the message's
+JSON metadata (which must carry ``kind``), optionally followed by
+``b"\\x00"`` and a raw binary blob whose slices are described — with
+their OWN per-array CRC32s — by the metadata's ``arrays`` table. The
+journal imports :func:`encode_payload`/:func:`parse_payload` from here,
+so a journal record and a wire message are byte-compatible payloads
+with different envelopes (file offset vs socket frame).
+
+Hard rules, all typed and all tested (``tests/test_wire.py``):
+
+- a torn, short, oversized or CRC-failing frame raises
+  :class:`WireError` — NEVER a partial apply, never a hang;
+- a peer that closes mid-frame raises :class:`WireClosed`;
+- every receive carries a deadline: silence past it raises
+  :class:`WireTimeout`. The fleet classifies any of the three as a
+  MEMBER fault (fence, respawn, recover tickets from the journal) —
+  a broken wire is a dead machine, not a dead ticket.
+
+Chaos (``resilience.inject``): ``wire_torn`` tears/corrupts one
+outgoing frame at this seam — ``tear="corrupt"`` flips bytes so the
+receiver's CRC check fires immediately; ``tear="truncate"`` sends the
+frame's prefix and CLOSES the connection (the realistic
+crash-mid-write shape), so the receiver sees ``WireClosed``, not an
+unbounded wait. The seam costs one module-global read when disarmed.
+
+This module's socket use is a deliberate BOUNDARY: the
+``raw-transport`` analysis rule flags raw ``socket``/``subprocess``
+calls anywhere else in the package, so every byte that crosses a
+process boundary flows through this codec (and is therefore
+CRC-checked and deadline-bounded).
+"""
+
+from __future__ import annotations
+
+import json
+import socket as _socket
+import time
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from ..resilience import inject
+
+__all__ = [
+    "WireError",
+    "WireTimeout",
+    "WireClosed",
+    "RemoteError",
+    "FrameConn",
+    "encode_payload",
+    "parse_payload",
+    "frame",
+    "REQUEST_KINDS",
+    "REPLY_KINDS",
+    "MAX_FRAME_BYTES",
+]
+
+_MAGIC = b"TW1 "
+_HEADER_LEN = 22  # b"TW1 " + 8 hex + b" " + 8 hex + b"\n"
+
+#: refuse to allocate for an absurd declared length (a corrupt header
+#: must fail as a typed error, not an OOM): 1 GiB bounds any realistic
+#: scenario-state payload by orders of magnitude
+MAX_FRAME_BYTES = 1 << 30
+
+#: the member RPC vocabulary (supervisor → member); every request gets
+#: exactly one reply frame
+REQUEST_KINDS = ("submit", "poll", "migrate", "queued", "pump", "drain",
+                 "stats", "dispatch_log", "heartbeat", "shutdown")
+#: reply kinds (member → supervisor)
+REPLY_KINDS = ("ok", "pending", "overloaded", "err")
+
+
+class WireError(ValueError):
+    """A frame failed to parse or verify (bad magic, short read,
+    oversized length, payload CRC mismatch, per-array CRC mismatch,
+    malformed metadata). The connection is UNSYNCHRONIZED after this —
+    the fleet treats it as a member fault, never retries the stream.
+    (A ``ValueError`` subclass so the journal reader's
+    truncate-to-verified-prefix scan handles wire-decoded payloads with
+    the same catch it always had.)"""
+
+
+class WireTimeout(WireError):
+    """The RPC deadline passed with the frame incomplete — the
+    classified-timeout half of the every-RPC-carries-a-deadline
+    contract (a hung wire becomes a member fault, not a hung fleet)."""
+
+
+class WireClosed(WireError):
+    """The peer closed (EOF) — mid-frame or between frames. A member
+    process that died mid-write lands here."""
+
+
+class RemoteError(RuntimeError):
+    """A member-side exception reconstructed on the supervisor side of
+    the wire: ``remote_type`` names the original class (quarantine
+    journaling and tests match on it), ``detail`` is its message."""
+
+    def __init__(self, remote_type: str, detail: str):
+        super().__init__(f"{remote_type}: {detail}")
+        self.remote_type = remote_type
+        self.detail = detail
+
+
+# -- payload codec (shared with the journal: one format, two envelopes) ------
+
+def encode_payload(meta: dict, arrays: Optional[dict] = None) -> bytes:
+    """JSON metadata + optional NUL-separated binary blob whose slices
+    (dtype/shape/offset/nbytes/crc32) are described by the metadata's
+    ``arrays`` table — the TJ1 payload format. ``meta`` is copied, not
+    mutated."""
+    body = dict(meta)
+    blob = b""
+    if arrays is not None:
+        table = {}
+        parts = []
+        off = 0
+        for name in sorted(arrays):
+            a = np.ascontiguousarray(np.asarray(arrays[name]))
+            raw = a.tobytes()
+            table[name] = {
+                "dtype": str(a.dtype), "shape": list(a.shape),
+                "offset": off, "nbytes": len(raw),
+                "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+            }
+            parts.append(raw)
+            off += len(raw)
+        body["arrays"] = table
+        blob = b"\x00" + b"".join(parts)
+    return json.dumps(body, sort_keys=True).encode() + blob
+
+
+def parse_payload(payload: bytes) -> tuple[dict, Optional[dict]]:
+    """Decode one payload back to ``(meta, arrays)``, verifying every
+    per-array CRC32. Raises :class:`WireError` on any malformation —
+    a declared-but-missing blob, a short slice, a CRC mismatch."""
+    cut = payload.find(b"\x00")
+    meta_bytes = payload if cut < 0 else payload[:cut]
+    try:
+        meta = json.loads(meta_bytes.decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"payload metadata failed to decode: {e}") from e
+    if not isinstance(meta, dict):
+        raise WireError(
+            f"payload metadata is {type(meta).__name__}, expected dict")
+    arrays = None
+    if "arrays" in meta:
+        if cut < 0:
+            raise WireError("payload declares arrays but carries no blob")
+        blob = payload[cut + 1:]
+        arrays = {}
+        try:
+            items = meta["arrays"].items()
+        except AttributeError as e:
+            raise WireError("payload arrays table is not a mapping") from e
+        for name, spec in items:
+            try:
+                raw = blob[spec["offset"]:spec["offset"] + spec["nbytes"]]
+                if len(raw) != spec["nbytes"]:
+                    raise WireError(f"array {name!r} blob slice short")
+                if (zlib.crc32(raw) & 0xFFFFFFFF) != spec["crc32"]:
+                    raise WireError(
+                        f"array {name!r} failed its per-array CRC32")
+                arrays[name] = np.frombuffer(
+                    raw, dtype=np.dtype(spec["dtype"])
+                ).reshape(tuple(spec["shape"])).copy()
+            except (KeyError, TypeError, ValueError) as e:
+                if isinstance(e, WireError):
+                    raise
+                raise WireError(
+                    f"array {name!r} table entry malformed: {e}") from e
+    return meta, arrays
+
+
+def frame(payload: bytes) -> bytes:
+    """One complete wire frame around ``payload``. Refuses an
+    over-cap payload on the SENDER: shipping it would make the
+    receiver reject the length and close — misclassifying an
+    oversized scenario as serial member death across the whole fleet
+    instead of one clear error naming the real problem."""
+    if len(payload) > MAX_FRAME_BYTES:
+        raise ValueError(
+            f"payload is {len(payload)} bytes — over the "
+            f"{MAX_FRAME_BYTES}-byte frame cap (a scenario too large "
+            "for the wire; shrink the state or raise the cap on BOTH "
+            "sides)")
+    header = b"TW1 %08x %08x\n" % (
+        len(payload), zlib.crc32(payload) & 0xFFFFFFFF)
+    return header + payload + b"\n"
+
+
+# -- the connection -----------------------------------------------------------
+
+class FrameConn:
+    """Frame-at-a-time messaging over one stream socket.
+
+    Not internally locked: each side serializes its use under its own
+    lock (the member client's RPC lock / the member server's single
+    serve thread) — the conn is a seam, not a shared service.
+    ``chaos_id`` names the member this conn belongs to so the
+    ``wire_torn``/``proc_kill``/``heartbeat_loss`` faults can target
+    one member by ``channel`` (the client side sets it; the server
+    side leaves it None so a fault fires exactly once per plan).
+    ``bytes_in``/``bytes_out`` are the observability counters the
+    fleet's ``stats()`` aggregates per member."""
+
+    def __init__(self, sock, chaos_id: Optional[str] = None):
+        self._sock = sock
+        self.chaos_id = chaos_id
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self._buf = b""
+        self._closed = False
+
+    # -- sending -------------------------------------------------------------
+
+    def send(self, kind: str, meta: Optional[dict] = None,
+             arrays: Optional[dict] = None,
+             deadline_s: Optional[float] = None) -> None:
+        """Frame and send one message. ``kind`` must be a known request
+        or reply kind (a typo'd kind fails HERE, on the sender, with a
+        stack trace — not as a mystery error on the peer)."""
+        if kind not in REQUEST_KINDS and kind not in REPLY_KINDS:
+            raise ValueError(
+                f"unknown wire message kind {kind!r} (expected one of "
+                f"{REQUEST_KINDS + REPLY_KINDS})")
+        body = dict(meta or {})
+        body["kind"] = kind
+        data = frame(encode_payload(body, arrays))
+        st = inject.active()
+        if st is not None:
+            f = st.member_fault(self.chaos_id, ("wire_torn",),
+                                site="wire", count=False)
+            if f is not None:
+                self._send_torn(data, f)
+                return
+        self._sendall(data, deadline_s)
+        self.bytes_out += len(data)
+
+    def _send_torn(self, data: bytes, fault) -> None:
+        """The ``wire_torn`` chaos seam: ``corrupt`` flips ``nbytes``
+        at ``offset`` (the receiver's CRC fires); ``truncate`` sends
+        only the first ``offset`` bytes and CLOSES — a write torn by a
+        crash, surfacing as ``WireClosed`` on the peer, never a hang."""
+        if fault.tear == "truncate":
+            cut = min(max(fault.offset, 0), len(data))
+            self._sendall(data[:cut], None)
+            self.bytes_out += cut
+            self.close()
+            return
+        off = min(max(fault.offset, 0), max(len(data) - 1, 0))
+        chunk = data[off:off + fault.nbytes]
+        data = (data[:off] + bytes(b ^ 0xFF for b in chunk)
+                + data[off + len(chunk):])
+        self._sendall(data, None)
+        self.bytes_out += len(data)
+
+    def _sendall(self, data: bytes, deadline_s: Optional[float]) -> None:
+        if self._closed:
+            raise WireClosed("connection already closed")
+        try:
+            self._sock.settimeout(deadline_s)
+            self._sock.sendall(data)
+        except _socket.timeout as e:
+            raise WireTimeout(
+                f"send blocked past its {deadline_s}s deadline") from e
+        except OSError as e:
+            raise WireClosed(f"send failed: {e}") from e
+
+    # -- receiving -----------------------------------------------------------
+
+    def recv(self, deadline_s: Optional[float] = None
+             ) -> tuple[str, dict, Optional[dict]]:
+        """Read exactly one frame: ``(kind, meta, arrays)``. Raises
+        :class:`WireTimeout` when ``deadline_s`` wall seconds pass with
+        the frame incomplete, :class:`WireClosed` on EOF,
+        :class:`WireError` on any framing/CRC failure.
+
+        ANY failure POISONS the connection (it closes): a stream that
+        timed out or failed a check is unsynchronized — a late reply
+        still in flight would otherwise pair with the NEXT request —
+        so the no-retries contract is enforced structurally, not by
+        caller discipline."""
+        try:
+            return self._recv(deadline_s)
+        except WireError:
+            self.close()
+            raise
+
+    def _recv(self, deadline_s: Optional[float]
+              ) -> tuple[str, dict, Optional[dict]]:
+        t_end = (None if deadline_s is None
+                 else time.monotonic() + float(deadline_s))
+        header = self._read_exact(_HEADER_LEN, t_end)
+        if header[:4] != _MAGIC or header[12:13] != b" " \
+                or header[21:22] != b"\n":
+            raise WireError(f"bad frame header {header!r}")
+        try:
+            n = int(header[4:12], 16)
+            want = int(header[13:21], 16)
+        except ValueError as e:
+            raise WireError(f"bad frame header {header!r}") from e
+        if n > MAX_FRAME_BYTES:
+            raise WireError(
+                f"frame declares {n} bytes (> {MAX_FRAME_BYTES} cap) — "
+                "refusing a corrupt length")
+        body = self._read_exact(n + 1, t_end)
+        payload, trailer = body[:n], body[n:]
+        if trailer != b"\n":
+            raise WireError("frame trailer missing")
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != want:
+            raise WireError("frame payload failed its CRC32")
+        meta, arrays = parse_payload(payload)
+        kind = meta.get("kind")
+        if not isinstance(kind, str):
+            raise WireError("frame metadata carries no kind")
+        return kind, meta, arrays
+
+    def _read_exact(self, n: int, t_end: Optional[float]) -> bytes:
+        # chunks accumulate in a LIST and join once: `bytes += chunk`
+        # re-copies the whole accumulation per chunk — quadratic on
+        # the multi-megabyte scenario frames this path exists for
+        chunks = [self._buf]
+        total = len(self._buf)
+        try:
+            while total < n:
+                if self._closed:
+                    raise WireClosed("connection already closed")
+                if t_end is not None:
+                    remaining = t_end - time.monotonic()
+                    if remaining <= 0:
+                        raise WireTimeout(
+                            "frame incomplete at its receive deadline "
+                            f"({total}/{n} bytes)")
+                    self._sock.settimeout(remaining)
+                else:
+                    self._sock.settimeout(None)
+                try:
+                    chunk = self._sock.recv(65536)
+                except _socket.timeout as e:
+                    raise WireTimeout(
+                        "frame incomplete at its receive deadline "
+                        f"({total}/{n} bytes)") from e
+                except OSError as e:
+                    raise WireClosed(f"recv failed: {e}") from e
+                if not chunk:
+                    raise WireClosed(
+                        f"peer closed mid-frame ({total}/{n} bytes)")
+                chunks.append(chunk)
+                total += len(chunk)
+                self.bytes_in += len(chunk)
+        finally:
+            # whatever arrived belongs to the stream even on an error
+            # path (the conn poisons on failure anyway, but the
+            # byte-counter/buffer accounting stays exact)
+            self._buf = b"".join(chunks)
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:  # pragma: no cover - close is best-effort
+            pass
+
+    def __enter__(self) -> "FrameConn":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -62,6 +62,21 @@ site           where the seam lives / what the fault does
                is appended (``offset`` is relative to that record's
                start), driving the recover-up-to-last-verified-entry
                path
+``wire``       the multi-process fleet's wire seams (ISSUE 13), all
+               targetable by ``channel`` = the member's ``service_id``
+               and thresholded by ``at`` on the fleet-wide wire-RPC
+               count: ``kind="proc_kill"`` delivers a REAL ``SIGKILL``
+               to the member process (the loopback fake hard-stops its
+               serve thread) — the supervisor must notice via missed
+               heartbeats, fence, respawn gen+1 and recover tickets;
+               ``kind="heartbeat_loss"`` makes the member's heartbeat
+               RPC behave as timed out (the member itself is healthy —
+               the failure detector path alone is exercised);
+               ``kind="wire_torn"`` tears one outgoing frame at the
+               ``ensemble.wire`` send seam (``tear="corrupt"`` flips
+               bytes so the peer's CRC fires; ``tear="truncate"`` sends
+               a prefix and closes — the crash-mid-write shape), and
+               the codec must raise its typed error, never hang
 =============  ==============================================================
 
 Zero overhead when disarmed: every seam starts with one module-global
@@ -80,6 +95,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
+import threading
 from typing import Optional
 
 __all__ = [
@@ -133,6 +149,10 @@ SITE_OF = {
     "member_kill": "pump",
     "member_wedge": "pump",
     "journal_torn": "journal",
+    # ISSUE 13: the multi-process fleet's wire seams
+    "proc_kill": "wire",
+    "heartbeat_loss": "wire",
+    "wire_torn": "wire",
 }
 
 
@@ -186,17 +206,17 @@ class Fault:
                 f"(expected one of {sorted(SITE_OF)})")
         if self.tear not in ("truncate", "corrupt"):
             raise ValueError(f"unknown tear mode {self.tear!r}")
-        if self.kind in ("member_kill", "member_wedge"):
-            if self.kind == "member_wedge" and not self.once \
-                    and self.channel is None:
-                # an unpinned sticky wedge would re-wedge every
-                # replacement generation: fence → restart → wedge,
-                # forever — pin the member it wedges
-                raise ValueError(
-                    "a sticky member_wedge (once=False) must pin its "
-                    "member via channel=service_id — unpinned it would "
-                    "wedge every replacement generation too, an "
-                    "unbounded fence/restart loop")
+        if (self.kind in ("member_wedge", "heartbeat_loss", "proc_kill",
+                          "wire_torn")
+                and not self.once and self.channel is None):
+            # an unpinned sticky member/wire fault would re-fault every
+            # replacement generation: fence → restart → fault, forever
+            # — pin the member it targets
+            raise ValueError(
+                f"a sticky {self.kind} (once=False) must pin its "
+                "member via channel=service_id — unpinned it would "
+                "hit every replacement generation too, an unbounded "
+                "fence/restart loop")
 
     @property
     def site(self) -> str:
@@ -228,10 +248,21 @@ class FaultPlan:
 class ArmedPlan:
     """Runtime state of one armed plan: per-site firing counters, the
     consumed-fault set, and the observable ``fired`` log (what actually
-    went off, in order — chaos tests assert completeness against it)."""
+    went off, in order — chaos tests assert completeness against it).
+
+    Internally locked since ISSUE 13: the wire seams consult
+    ``member_fault``/``bump`` from every client thread plus the
+    supervision tick concurrently (the pre-wire seams all ran on one
+    pump/tick thread), and a racing read-modify-write on the counters
+    or the consumed set would shift ``at`` thresholds or double-fire a
+    ``once`` fault — nondeterministic chaos under exactly the
+    multi-threaded load the seams exist to test. The mutex is a plain
+    leaf lock (nothing is ever acquired under it; lockdep factories
+    would invert the inject-imports-nothing layering)."""
 
     def __init__(self, plan: FaultPlan):
         self.plan = plan
+        self._mutex = threading.Lock()
         self._counters: dict = {}
         self._consumed: set = set()
         #: [{"index", "site", "kind", "at"}] — every firing, in order
@@ -245,63 +276,82 @@ class ArmedPlan:
     def bump(self, site: str) -> int:
         """Advance and return ``site``'s firing index (counts every
         seam visit — retries included — so ``at`` is deterministic)."""
-        idx = self._counters.get(site, 0)
-        self._counters[site] = idx + 1
-        return idx
+        with self._mutex:
+            idx = self._counters.get(site, 0)
+            self._counters[site] = idx + 1
+            return idx
 
     def take(self, site: str, index: Optional[int] = None,
              kinds: Optional[tuple] = None) -> Optional[Fault]:
         """First live fault matching (site, index, kinds); consumes it
         when ``once``. ``index=None`` matches only index-unpinned
         faults."""
-        for i, f in enumerate(self.plan.faults):
-            if f.site != site or (kinds is not None and f.kind not in kinds):
-                continue
-            if i in self._consumed:
-                continue
-            if f.at is not None and f.at != index:
-                continue
-            if f.ticket is not None:
-                continue  # ticket faults fire via ticket_fault only
-            self._fire(i, f)
-            return f
-        return None
+        with self._mutex:
+            for i, f in enumerate(self.plan.faults):
+                if f.site != site or (kinds is not None
+                                      and f.kind not in kinds):
+                    continue
+                if i in self._consumed:
+                    continue
+                if f.at is not None and f.at != index:
+                    continue
+                if f.ticket is not None:
+                    continue  # ticket faults fire via ticket_fault only
+                self._fire_locked(i, f)
+                return f
+            return None
 
-    def member_fault(self, service_id, kinds: tuple) -> Optional[Fault]:
-        """Live member fault (``member_kill``/``member_wedge``) aimed at
-        ``service_id``: a fault whose ``channel`` is None (any member)
-        or equals the id, and whose ``at`` threshold — a minimum
-        fleet-wide pump-site visit count, for mid-soak timing — has
-        been reached. Consumed per ``once`` — a sticky wedge
-        (``once=False``, channel-pinned by construction) re-fires every
-        pump until its member is restarted under a new id."""
-        pumps = self._counters.get("pump", 0)
-        for i, f in enumerate(self.plan.faults):
-            if f.kind not in kinds or i in self._consumed:
-                continue
-            if f.channel is not None and f.channel != service_id:
-                continue
-            if f.at is not None and pumps < f.at:
-                continue
-            self._fire(i, f)
-            return f
-        return None
+    def member_fault(self, service_id, kinds: tuple, site: str = "pump",
+                     count: bool = False) -> Optional[Fault]:
+        """Live member-targeted fault (``member_kill``/``member_wedge``
+        on the pump site; ``proc_kill``/``heartbeat_loss``/
+        ``wire_torn`` on the wire site) aimed at ``service_id``: a
+        fault whose ``channel`` is None (any member) or equals the id,
+        and whose ``at`` threshold — a minimum fleet-wide ``site``
+        visit count, for mid-soak timing — has been reached.
+        ``count=True`` advances the site counter first (the wire seams
+        count per RPC through this call; the pump seam keeps its own
+        explicit ``bump``). Consumed per ``once`` — a sticky fault
+        (``once=False``, channel-pinned by construction) re-fires until
+        its member is restarted under a new id."""
+        with self._mutex:
+            if count:
+                idx = self._counters.get(site, 0)
+                self._counters[site] = idx + 1
+            pumps = self._counters.get(site, 0)
+            for i, f in enumerate(self.plan.faults):
+                if f.kind not in kinds or i in self._consumed:
+                    continue
+                if f.channel is not None and f.channel != service_id:
+                    continue
+                if f.at is not None and pumps < f.at:
+                    continue
+                self._fire_locked(i, f)
+                return f
+            return None
 
     def ticket_fault(self, ticket) -> Optional[Fault]:
         """Live ``lane_nan`` fault bound to ``ticket`` (the scheduler's
         per-dispatch lane mapping); consumed per its ``once``."""
-        for i, f in enumerate(self.plan.faults):
-            if (f.kind == "lane_nan" and f.ticket == ticket
-                    and i not in self._consumed):
-                self._fire(i, f)
-                return f
-        return None
+        with self._mutex:
+            for i, f in enumerate(self.plan.faults):
+                if (f.kind == "lane_nan" and f.ticket == ticket
+                        and i not in self._consumed):
+                    self._fire_locked(i, f)
+                    return f
+            return None
 
-    def _fire(self, i: int, f: Fault) -> None:
+    def _fire_locked(self, i: int, f: Fault) -> None:
         if f.once:
             self._consumed.add(i)
         self.fired.append({"index": i, "site": f.site, "kind": f.kind,
                            "at": f.at})
+
+    def _fire(self, i: int, f: Fault) -> None:
+        """Mark fault ``i`` fired (the single-threaded seam helpers —
+        checkpoint/journal tears, lane poisons — call this)."""
+        with self._mutex:
+            self._fire_locked(i, f)
 
     # -- halo window (trace-time seam, chunk-scoped) -----------------------
 
@@ -310,20 +360,25 @@ class ArmedPlan:
         """Arm the trace-time halo perturbation for the duration of ONE
         executor chunk; pad_with_halo_* read it while tracing."""
         idx = self.plan.faults.index(fault)
-        self.halo_eps = (fault.value if fault.value is not None
-                         else self.plan.value_for(idx))
+        eps = (fault.value if fault.value is not None
+               else self.plan.value_for(idx))
+        with self._mutex:
+            self.halo_eps = eps
         try:
             yield
         finally:
-            self.halo_eps = None
+            with self._mutex:
+                self.halo_eps = None
 
     # -- ensemble lane poisons (scheduler ticket → lane mapping) -----------
 
     def push_lane_poisons(self, poisons: list) -> None:
-        self._lane_poisons = list(poisons)
+        with self._mutex:
+            self._lane_poisons = list(poisons)
 
     def clear_lane_poisons(self) -> None:
-        self._lane_poisons = []
+        with self._mutex:
+            self._lane_poisons = []
 
     def ensemble_poisons(self, index: int) -> list:
         """(lane, Fault) pairs to poison in this ``run_ensemble`` call:

@@ -246,7 +246,9 @@ class ThroughputCounter:
     COUNTERS = ("dispatches", "scenarios", "lanes", "cache_hits",
                 "solo_retries", "recovered_failures", "quarantined",
                 "impl_faults", "shed", "expired", "loop_faults",
-                "member_faults", "readmitted", "scale_ups", "scale_downs")
+                "member_faults", "readmitted", "scale_ups", "scale_downs",
+                "respawns", "heartbeats", "heartbeat_misses",
+                "wire_errors")
 
     def __init__(self):
         # lockdep factory (ISSUE 12): plain Lock disarmed, witnessed
@@ -285,6 +287,13 @@ class ThroughputCounter:
         #: autoscaling actions (fleet supervisor)
         self.scale_ups = 0
         self.scale_downs = 0
+        #: ISSUE 13 (multi-process fleet): members respawned in place
+        #: (fence → gen+1), heartbeat RPCs sent / missed, and wire
+        #: failures classified as member faults
+        self.respawns = 0
+        self.heartbeats = 0
+        self.heartbeat_misses = 0
+        self.wire_errors = 0
         self._latencies: collections.deque = collections.deque(
             maxlen=LATENCY_RESERVOIR)
 
@@ -357,6 +366,10 @@ class ThroughputCounter:
                 "readmitted": self.readmitted,
                 "scale_ups": self.scale_ups,
                 "scale_downs": self.scale_downs,
+                "respawns": self.respawns,
+                "heartbeats": self.heartbeats,
+                "heartbeat_misses": self.heartbeat_misses,
+                "wire_errors": self.wire_errors,
                 "latency_n": len(lat),
                 "latency_p50_s": (self._percentile(lat, 0.50)
                                   if lat else None),

@@ -279,8 +279,10 @@ def test_heavy_test_rule_fires_via_engine():
            "def test_spawns():\n"
            "    subprocess.run(['true'])\n")
     assert rules_of(lint_source(src, "tests/test_fake.py")) == ["heavy-test"]
-    # non-test files are out of scope for the rule
-    assert rules_of(lint_source(src, PKG)) == []
+    # non-test files are out of scope for heavy-test; the same raw
+    # subprocess call in PACKAGE scope is the raw-transport rule's
+    # (ISSUE 13) — the two rules split exactly on the scope line
+    assert rules_of(lint_source(src, PKG)) == ["raw-transport"]
 
 
 def test_heavy_test_rule_respects_slow_marker():
@@ -444,6 +446,54 @@ def test_jaxpr_audit_ensemble_golden():
     closed = jax.make_jaxpr(built.fn)(*built.args)
     assert all(a.shape[0] == 3 and str(a.dtype) == "float64"
                for a in closed.out_avals)
+
+
+# -- raw-transport (ISSUE 13: the wire boundary) ------------------------------
+
+def test_raw_transport_positive():
+    src = ("import socket, subprocess\n"
+           "def f(code):\n"
+           "    s = socket.socket()\n"
+           "    p = subprocess.Popen([code])\n"
+           "    subprocess.check_output(['x'])\n")
+    assert rules_of(lint_source(src, PKG)) == ["raw-transport"] * 3
+    # from-imports of the unambiguous spawn names are caught too
+    src2 = ("from subprocess import Popen\n"
+            "from socket import socketpair\n"
+            "def g():\n"
+            "    Popen(['x'])\n"
+            "    a, b = socketpair()\n")
+    assert rules_of(lint_source(src2, PKG)) == ["raw-transport"] * 2
+
+
+def test_raw_transport_allowed_at_the_wire_boundary():
+    src = ("import socket\n"
+           "def f():\n"
+           "    return socket.socketpair()\n")
+    for ok in ("mpi_model_tpu/ensemble/wire.py",
+               "mpi_model_tpu/ensemble/member_proc.py"):
+        assert rules_of(lint_source(src, ok)) == []
+    assert rules_of(lint_source(src, PKG)) == ["raw-transport"]
+
+
+def test_raw_transport_negative_generic_names():
+    # "run"/"call"/"socket" alone are far too generic to flag bare,
+    # and non-transport receivers never fire
+    src = ("def f(model, space, executor, sched):\n"
+           "    executor.run(space)\n"
+           "    sched.call(1)\n"
+           "    model.socket = 3\n"
+           "    run = f\n")
+    assert rules_of(lint_source(src, PKG)) == []
+
+
+def test_raw_transport_pragma_with_reason():
+    src = ("import subprocess\n"
+           "def f():\n"
+           "    # analysis: ignore[raw-transport] — a build tool, not\n"
+           "    # serving traffic\n"
+           "    subprocess.run(['cmake'])\n")
+    assert rules_of(lint_source(src, PKG)) == []
 
 
 # -- the repo gate ------------------------------------------------------------

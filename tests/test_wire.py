@@ -1,0 +1,307 @@
+"""Wire codec tests (ISSUE 13 satellite): property-style round-trips
+over every message kind (including a full scenario-state payload),
+plus the adversarial half — a frame torn at EVERY byte boundary and a
+frame with any byte flipped must raise the typed wire errors, never
+hang, never partially apply. The socketpair here is the same transport
+the loopback fleet fake uses: real sockets, zero subprocesses."""
+
+import socket
+import zlib
+
+import numpy as np
+import pytest
+
+from mpi_model_tpu.ensemble.wire import (
+    MAX_FRAME_BYTES,
+    REPLY_KINDS,
+    REQUEST_KINDS,
+    FrameConn,
+    RemoteError,
+    WireClosed,
+    WireError,
+    WireTimeout,
+    encode_payload,
+    frame,
+    parse_payload,
+)
+from mpi_model_tpu.resilience import inject
+from mpi_model_tpu.resilience.inject import Fault, FaultPlan
+
+
+def conn_pair():
+    a, b = socket.socketpair()
+    return FrameConn(a), FrameConn(b)
+
+
+RNG = np.random.default_rng(7)
+
+#: a full scenario-state payload: the f64 channel grid + a bool mask +
+#: an int32 lane — every storage dtype class the space can carry
+SCENARIO_ARRAYS = {
+    "value": RNG.uniform(0.5, 2.0, (16, 16)),
+    "mask": RNG.uniform(size=(16, 16)) > 0.5,
+    "ids": RNG.integers(0, 1 << 30, (16,), dtype=np.int32),
+    "f32": RNG.uniform(-1, 1, (4, 4)).astype(np.float32),
+}
+
+
+# -- round-trips --------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", sorted(REQUEST_KINDS + REPLY_KINDS))
+def test_roundtrip_every_kind_with_scenario_payload(kind):
+    """Every message kind crosses a real socketpair with a full
+    scenario-state arrays payload and rich metadata — and comes back
+    BITWISE: same bytes, same dtypes, same shapes."""
+    c, s = conn_pair()
+    meta = {"ticket": 3, "steps": 8, "dim_x": 16, "dim_y": 16,
+            "model": {"flows": [{"type": "Diffusion",
+                                 "params": {"rate": 0.05}}]},
+            "nested": {"a": [1, 2.5, None, True], "b": "text"}}
+    c.send(kind, meta, SCENARIO_ARRAYS)
+    got_kind, got_meta, got_arrays = s.recv(deadline_s=5.0)
+    assert got_kind == kind
+    for k, v in meta.items():
+        assert got_meta[k] == v
+    assert set(got_arrays) == set(SCENARIO_ARRAYS)
+    for k, a in SCENARIO_ARRAYS.items():
+        assert got_arrays[k].dtype == np.asarray(a).dtype
+        np.testing.assert_array_equal(got_arrays[k], np.asarray(a))
+    c.close()
+    s.close()
+
+
+def test_roundtrip_no_arrays_and_empty_meta():
+    c, s = conn_pair()
+    c.send("heartbeat")
+    kind, meta, arrays = s.recv(deadline_s=5.0)
+    assert kind == "heartbeat" and arrays is None
+    c.close(), s.close()
+
+
+def test_payload_codec_roundtrip_is_bitwise():
+    payload = encode_payload({"kind": "submit", "x": 1}, SCENARIO_ARRAYS)
+    meta, arrays = parse_payload(payload)
+    assert meta["kind"] == "submit" and meta["x"] == 1
+    for k, a in SCENARIO_ARRAYS.items():
+        assert arrays[k].tobytes() == np.ascontiguousarray(
+            np.asarray(a)).tobytes()
+
+
+def test_unknown_kind_fails_on_the_sender():
+    c, _s = conn_pair()
+    with pytest.raises(ValueError, match="unknown wire message kind"):
+        c.send("not-a-kind", {})
+
+
+def test_byte_counters_move_both_ways():
+    c, s = conn_pair()
+    c.send("poll", {"ticket": 1})
+    s.recv(deadline_s=5.0)
+    s.send("pending", {})
+    c.recv(deadline_s=5.0)
+    assert c.bytes_out > 0 and s.bytes_in == c.bytes_out
+    assert s.bytes_out > 0 and c.bytes_in == s.bytes_out
+    c.close(), s.close()
+
+
+# -- the adversarial half -----------------------------------------------------
+
+def _small_frame() -> bytes:
+    return frame(encode_payload({"kind": "poll", "ticket": 7},
+                                {"v": np.arange(3.0)}))
+
+
+def test_torn_at_every_boundary_raises_typed_never_hangs():
+    """A peer that dies after ANY prefix of a frame: the reader must
+    raise a typed wire error — at every single byte boundary — and
+    must never hang or deliver a partial message."""
+    data = _small_frame()
+    for i in range(len(data)):
+        a, b = socket.socketpair()
+        c, s = FrameConn(a), FrameConn(b)
+        a.sendall(data[:i])
+        c.close()  # EOF mid-frame: the crash shape
+        with pytest.raises(WireError):
+            s.recv(deadline_s=5.0)
+        s.close()
+
+
+def test_bit_flip_at_every_position_raises_typed():
+    """Any single corrupted byte — header, metadata, blob, trailer —
+    must surface as a typed wire error, never as an accepted frame.
+    (Flips that corrupt the declared LENGTH make the remainder short;
+    closing after the write turns that into a typed EOF, not a wait.)"""
+    data = _small_frame()
+    for i in range(len(data)):
+        flipped = data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+        a, b = socket.socketpair()
+        c, s = FrameConn(a), FrameConn(b)
+        a.sendall(flipped)
+        c.close()
+        with pytest.raises(WireError):
+            s.recv(deadline_s=5.0)
+        s.close()
+
+
+def test_per_array_crc_failure_is_typed_even_with_valid_frame_crc():
+    """An array whose bytes were swapped AFTER framing (frame CRC
+    recomputed by the attacker/bug) still fails its per-array CRC32 —
+    the journal's defense-in-depth, alive on the wire too."""
+    payload = bytearray(encode_payload({"kind": "poll"},
+                                       {"v": np.arange(8.0)}))
+    cut = bytes(payload).find(b"\x00")
+    payload[cut + 1] ^= 0xFF  # corrupt the blob, then REframe validly
+    with pytest.raises(WireError, match="per-array CRC32"):
+        parse_payload(bytes(payload))
+    a, b = socket.socketpair()
+    c, s = FrameConn(a), FrameConn(b)
+    a.sendall(frame(bytes(payload)))
+    with pytest.raises(WireError, match="per-array CRC32"):
+        s.recv(deadline_s=5.0)
+    c.close(), s.close()
+
+
+def test_oversized_payload_refused_on_the_sender(monkeypatch):
+    """An over-cap payload fails on the SENDER with a clear ValueError
+    naming the size — shipping it would make every receiver reject the
+    length and close, misclassifying one oversized scenario as serial
+    member death across the fleet."""
+    import mpi_model_tpu.ensemble.wire as wire_mod
+
+    monkeypatch.setattr(wire_mod, "MAX_FRAME_BYTES", 64)
+    big = encode_payload({"kind": "submit"}, {"v": np.zeros(64)})
+    with pytest.raises(ValueError, match="frame cap"):
+        wire_mod.frame(big)
+    a, b = socket.socketpair()
+    c = FrameConn(a)
+    with pytest.raises(ValueError, match="frame cap"):
+        c.send("submit", {}, {"v": np.zeros(64)})
+    c.close(), b.close()
+
+
+def test_oversized_declared_length_refused():
+    header = b"TW1 %08x %08x\n" % (MAX_FRAME_BYTES + 1, 0)
+    a, b = socket.socketpair()
+    s = FrameConn(b)
+    a.sendall(header)
+    with pytest.raises(WireError, match="refusing a corrupt length"):
+        s.recv(deadline_s=5.0)
+    a.close()
+    s.close()
+
+
+def test_recv_deadline_is_a_classified_timeout():
+    """Silence past the deadline → WireTimeout, the classified-timeout
+    half of every-RPC-carries-a-deadline (a hung wire is a member
+    fault, not a hung fleet) — and the failure POISONS the conn: a
+    late reply must never pair with the next request."""
+    a, b = socket.socketpair()
+    s = FrameConn(b)
+    with pytest.raises(WireTimeout):
+        s.recv(deadline_s=0.05)
+    assert s.closed  # poisoned: the stream is unsynchronized
+    with pytest.raises(WireClosed):
+        s.recv(deadline_s=0.05)
+    a.close()
+    # a partial frame then silence is ALSO a timeout, not a hang
+    a2, b2 = socket.socketpair()
+    s2 = FrameConn(b2)
+    a2.sendall(_small_frame()[:10])
+    with pytest.raises(WireTimeout):
+        s2.recv(deadline_s=0.05)
+    assert s2.closed
+    a2.close()
+
+
+def test_trailing_garbage_after_valid_frame_fails_next_recv():
+    data = _small_frame() + b"garbage-that-is-not-a-frame-header!!"
+    a, b = socket.socketpair()
+    c, s = FrameConn(a), FrameConn(b)
+    a.sendall(data)
+    kind, meta, arrays = s.recv(deadline_s=5.0)  # first frame intact
+    assert kind == "poll" and meta["ticket"] == 7
+    with pytest.raises(WireError, match="bad frame header"):
+        s.recv(deadline_s=5.0)
+    c.close(), s.close()
+
+
+def test_payload_malformations_are_typed():
+    with pytest.raises(WireError, match="failed to decode"):
+        parse_payload(b"\xff\xfe not json")
+    with pytest.raises(WireError, match="expected dict"):
+        parse_payload(b"[1, 2, 3]")
+    with pytest.raises(WireError, match="carries no blob"):
+        parse_payload(b'{"kind": "ok", "arrays": {"v": {}}}')
+    # a declared slice reaching past the blob is short, not a crash
+    bad = (b'{"arrays": {"v": {"dtype": "float64", "shape": [64], '
+           b'"offset": 0, "nbytes": 512, "crc32": 0}}, "kind": "ok"}'
+           b"\x00" + b"\x00" * 8)
+    with pytest.raises(WireError, match="short"):
+        parse_payload(bad)
+
+
+def test_frame_missing_kind_is_typed():
+    a, b = socket.socketpair()
+    s = FrameConn(b)
+    a.sendall(frame(encode_payload({"no_kind": True})))
+    with pytest.raises(WireError, match="no kind"):
+        s.recv(deadline_s=5.0)
+    a.close(), s.close()
+
+
+def test_remote_error_preserves_the_member_side_class():
+    e = RemoteError("EnsembleConservationError", "lane 3 diverged")
+    assert e.remote_type == "EnsembleConservationError"
+    assert "EnsembleConservationError" in str(e)
+    assert "lane 3 diverged" in str(e)
+
+
+# -- the wire_torn chaos seam -------------------------------------------------
+
+def test_wire_torn_corrupt_fires_the_receivers_crc():
+    c, s = conn_pair()
+    c.chaos_id = "m0g0"
+    plan = FaultPlan((Fault("wire_torn", channel="m0g0", offset=30,
+                            nbytes=4, tear="corrupt"),))
+    with inject.armed(plan) as st:
+        c.send("poll", {"ticket": 1})
+    assert [f["kind"] for f in st.fired] == ["wire_torn"]
+    with pytest.raises(WireError):
+        s.recv(deadline_s=5.0)
+    c.close(), s.close()
+
+
+def test_wire_torn_truncate_closes_like_a_crash_mid_write():
+    c, s = conn_pair()
+    c.chaos_id = "m0g0"
+    plan = FaultPlan((Fault("wire_torn", channel="m0g0", offset=9,
+                            tear="truncate"),))
+    with inject.armed(plan) as st:
+        c.send("poll", {"ticket": 1})
+    assert [f["kind"] for f in st.fired] == ["wire_torn"]
+    assert c.closed  # the writer "crashed"
+    with pytest.raises(WireClosed):
+        s.recv(deadline_s=5.0)
+    s.close()
+
+
+def test_wire_torn_pinned_to_another_member_does_not_fire():
+    c, s = conn_pair()
+    c.chaos_id = "m0g0"
+    plan = FaultPlan((Fault("wire_torn", channel="m9g9",
+                            tear="corrupt"),))
+    with inject.armed(plan) as st:
+        c.send("poll", {"ticket": 1})
+        kind, meta, _ = s.recv(deadline_s=5.0)
+    assert kind == "poll" and meta["ticket"] == 1
+    assert not st.fired
+    c.close(), s.close()
+
+
+def test_sticky_wire_faults_must_pin_their_member():
+    with pytest.raises(ValueError, match="must pin its"):
+        Fault("wire_torn", once=False)
+    with pytest.raises(ValueError, match="must pin its"):
+        Fault("heartbeat_loss", once=False)
+    with pytest.raises(ValueError, match="must pin its"):
+        Fault("proc_kill", once=False)
