@@ -885,6 +885,218 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
     }
 
 
+def bench_tiering(grid: int = 128, B: int = 8, steps: int = 4,
+                  dtype_name: str = "float32", n_scenarios: int = 120,
+                  working_set_factor: int = 10,
+                  verbose: bool = False) -> dict:
+    """Scenario-tiering soak (ISSUE 14): a fake-clock open-loop soak
+    whose WORKING SET is ``working_set_factor``× the residency budget —
+    the paging tier must absorb the whole overflow with ZERO sheds,
+    every woken scenario bitwise-equal to its never-hibernated twin,
+    and the measured wake latency (chain materialization wall seconds)
+    bounded. The run ABORTS on any shed, any lost ticket, or any
+    bitwise mismatch.
+
+    Three legs:
+
+    1. **Paged soak** — ``n_scenarios`` submissions into a journaled
+       2-member manual fleet whose residency budget holds ~1/10th of
+       them; the rest hibernate to keyframe chains and wake FIFO as
+       capacity frees. Reports hibernations/wakes/wake-latency
+       percentiles and the complete ledger.
+    2. **Delta-paging micro-leg** — hibernate → wake → re-hibernate one
+       scenario through ``ScenarioTiering`` directly and report the
+       re-hibernation record bytes as a fraction of the keyframe (the
+       "paging through the delta stream" claim, measured).
+    3. **Kill-mid-soak recovery** — a journaled tiered fleet is
+       hard-abandoned with scenarios still hibernated;
+       ``FleetSupervisor.recover`` re-enters them in the hibernation
+       tier from their chains, every ticket resolves bitwise, and the
+       journal replay audit proves exactly-once.
+    """
+    import tempfile
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_model_tpu import CellularSpace, Diffusion, Model
+    from mpi_model_tpu.ensemble import (EnsembleService, FleetSupervisor,
+                                        buckets_for, scenario_nbytes)
+    from mpi_model_tpu.ensemble.journal import journal_path, replay
+    from mpi_model_tpu.ensemble.tiering import ScenarioTiering
+
+    enable_compile_cache()
+    dtype = jnp.dtype(dtype_name)
+    rng = np.random.default_rng(29)
+    base = rng.uniform(0.5, 2.0, (grid, grid)).astype(np.float32)
+    pool_spaces, pool_models = [], []
+    for i in range(B):
+        v = jnp.asarray(np.roll(base, 7 * i, axis=0), dtype)
+        pool_spaces.append(CellularSpace.create(grid, grid, 1.0,
+                                                dtype=dtype)
+                           .with_values({"value": v}))
+        pool_models.append(
+            Model(Diffusion(RATE * (1.0 + 0.05 * i / max(B - 1, 1))),
+                  1.0, 1.0))
+    template = pool_models[0]
+    kwargs = dict(steps=steps, impl="xla", buckets=buckets_for(B),
+                  retry="solo")
+
+    # never-hibernated twins: the bitwise gate's reference states
+    sync = EnsembleService(template, **kwargs)
+    ts = [sync.submit(pool_spaces[i], model=pool_models[i])
+          for i in range(B)]
+    sync.flush()
+    want = [np.asarray(sync.result(t)[0].values["value"]) for t in ts]
+
+    one = scenario_nbytes(pool_spaces[0])
+    working_set = one * n_scenarios
+    budget = max(one, working_set // working_set_factor)
+
+    # -- leg 1: the paged soak (fake clock — latency percentiles on
+    # the wake side are real wall seconds by construction)
+    clock = {"t": 0.0}
+    jd = tempfile.mkdtemp(prefix="tier-journal-")
+    hd = tempfile.mkdtemp(prefix="tier-vault-")
+    fleet = FleetSupervisor(template, services=2, start=False,
+                            journal_dir=jd, residency_budget=budget,
+                            hibernate_dir=hd, max_queue=n_scenarios,
+                            clock=lambda: clock["t"], **kwargs)
+    tickets = []
+    for i in range(n_scenarios):
+        clock["t"] += 0.001
+        tickets.append(fleet.submit(pool_spaces[i % B],
+                                    model=pool_models[i % B],
+                                    steps=steps))
+    st_mid = fleet.stats()
+    peak_hibernated = st_mid["hibernated_scenarios"]
+    served = 0
+    for i, t in enumerate(tickets):
+        space, _rep = fleet.result(t)
+        served += 1
+        if not np.array_equal(np.asarray(space.values["value"]),
+                              want[i % B]):
+            raise AssertionError(
+                f"tiering soak: woken scenario {i} (ticket {t}) is not "
+                "bitwise-equal to its never-hibernated twin")
+    st = fleet.stats()
+    fleet.stop()
+    if st["shed"] != 0:
+        raise AssertionError(
+            f"tiering soak SHED {st['shed']} submissions — paging must "
+            "absorb a working set "
+            f"{working_set_factor}x the budget with zero sheds")
+    if served != n_scenarios:
+        raise AssertionError(
+            f"tiering soak lost tickets: served {served}/{n_scenarios}")
+    audit = replay(journal_path(jd))
+    if audit.unresolved() or audit.duplicate_terminals:
+        raise AssertionError(
+            f"tiering soak journal audit failed: unresolved="
+            f"{audit.unresolved()} duplicates="
+            f"{audit.duplicate_terminals}")
+    if verbose:
+        print(f"  tiering soak: {served}/{n_scenarios} served, "
+              f"{st['hibernations']} hibernations "
+              f"(peak {peak_hibernated} paged out), "
+              f"{st['wakes']} wakes, wake p99 "
+              f"{st['wake_latency_p99_s'] * 1e3:.2f} ms, 0 sheds",
+              file=sys.stderr)
+
+    # -- leg 2: the delta-paging micro-leg (re-hibernation bytes)
+    vd = tempfile.mkdtemp(prefix="tier-delta-")
+    vault = ScenarioTiering(vd, residency_budget=one)
+    vault.hibernate(0, pool_spaces[0], template, steps)
+    kf_bytes = vault.stats()["hibernated_bytes"]
+    sp0, _e = vault.wake(0)
+    vault.hibernate(0, sp0, template, steps)
+    delta_bytes = vault.stats()["hibernated_bytes"] - kf_bytes
+    vault.close()
+    delta_fraction = delta_bytes / kf_bytes if kf_bytes else None
+    if verbose:
+        print(f"  delta paging: keyframe {kf_bytes} B, re-hibernation "
+              f"delta {delta_bytes} B "
+              f"({100 * delta_fraction:.2f}% of keyframe)",
+              file=sys.stderr)
+
+    # -- leg 3: kill mid-soak with scenarios still hibernated
+    kd = tempfile.mkdtemp(prefix="tier-kill-journal-")
+    kv = tempfile.mkdtemp(prefix="tier-kill-vault-")
+    k = 4 * B
+    kf = FleetSupervisor(template, services=2, start=False,
+                         journal_dir=kd, residency_budget=4 * one,
+                         hibernate_dir=kv, max_queue=k,
+                         clock=lambda: clock["t"], **kwargs)
+    kts = [kf.submit(pool_spaces[i % B], model=pool_models[i % B],
+                     steps=steps) for i in range(k)]
+    hibernated_at_kill = kf.stats()["hibernated_scenarios"]
+    kf.abandon()
+    if hibernated_at_kill == 0:
+        raise AssertionError(
+            "kill leg: nothing was hibernated at the kill — the leg "
+            "proves nothing at this geometry")
+    r2 = FleetSupervisor.recover(kd, template, services=2, start=False,
+                                 residency_budget=4 * one,
+                                 hibernate_dir=kv, max_queue=k,
+                                 clock=lambda: clock["t"], **kwargs)
+    rehydrated = r2.stats()["hibernated_scenarios"]
+    k_served = 0
+    for i, t in enumerate(kts):
+        space, _rep = r2.result(t)
+        if not np.array_equal(np.asarray(space.values["value"]),
+                              want[i % B]):
+            raise AssertionError(
+                f"kill leg: recovered scenario {i} not bitwise-equal "
+                "to its twin")
+        k_served += 1
+    r2.stop()
+    k_audit = replay(journal_path(kd))
+    recovery_ok = (k_served == k and not k_audit.unresolved()
+                   and not k_audit.duplicate_terminals)
+    if not recovery_ok:
+        raise AssertionError(
+            f"kill leg audit failed: served {k_served}/{k}, "
+            f"unresolved={k_audit.unresolved()}, duplicates="
+            f"{k_audit.duplicate_terminals}")
+    if verbose:
+        print(f"  kill leg: {hibernated_at_kill} hibernated at the "
+              f"kill, {rehydrated} re-entered the tier at recovery, "
+              f"{k_served}/{k} served bitwise, audit exactly-once OK",
+              file=sys.stderr)
+
+    return {
+        "metric": f"tiering soak ({n_scenarios}x {grid}^2 {dtype_name}"
+                  f", working set {working_set_factor}x budget)",
+        "grid": grid, "ensemble_B": B, "steps": steps,
+        "n_scenarios": n_scenarios,
+        "scenario_bytes": one,
+        "working_set_bytes": working_set,
+        "residency_budget_bytes": budget,
+        "working_set_factor": working_set_factor,
+        "served": served,
+        "shed": st["shed"],
+        "hibernations": st["hibernations"],
+        "rehibernations": st["rehibernations"],
+        "wakes": st["wakes"],
+        "wake_faults": st["wake_faults"],
+        "peak_hibernated_scenarios": peak_hibernated,
+        "wake_latency_p50_s": st["wake_latency_p50_s"],
+        "wake_latency_p99_s": st["wake_latency_p99_s"],
+        "wakes_by_member": st["wakes_by_member"],
+        # reached only when every comparison passed (a mismatch aborts)
+        "bitwise_ok": True,
+        "keyframe_bytes": kf_bytes,
+        "rehibernate_delta_bytes": delta_bytes,
+        "delta_fraction_of_keyframe": delta_fraction,
+        "kill_hibernated_at_kill": hibernated_at_kill,
+        "kill_rehydrated": rehydrated,
+        "kill_served": k_served,
+        "recovery_ok": recovery_ok,
+        "device_kind": getattr(jax.devices()[0], "device_kind", None),
+    }
+
+
 def _active_workload(grid: int, frac: float, dtype, rng):
     """Point-source wavefront covering ~``frac`` of the domain: a zero
     ocean with a centered random square of side ``grid*sqrt(frac)`` —
@@ -1586,6 +1798,16 @@ if __name__ == "__main__":
             # work, no chip required (the active executor steps the
             # workload on whatever backend is present)
             result = bench_checkpoint(verbose="-v" in sys.argv)
+        elif "--tiering" in sys.argv:
+            # the scenario-tiering soak (ISSUE 14): working set 10x
+            # the residency budget through the hibernate/wake paging
+            # tier with zero sheds, bitwise wakes, and the
+            # kill-mid-soak recovery leg; persists as the round's
+            # BENCH_TIER artifact
+            result = bench_tiering(verbose="-v" in sys.argv)
+            with open("BENCH_TIER_r01.json", "w") as fh:
+                json.dump(result, fh, indent=2)
+                fh.write("\n")
         elif "--serve" in sys.argv:
             # the always-on serving soak (ISSUE 9): open-loop arrivals
             # with chaos armed; --serve-services=N (ISSUE 10) shards

@@ -868,9 +868,32 @@ def config11(quick: bool = False) -> dict:
             **row}
 
 
+def config12(quick: bool = False) -> dict:
+    """Scenario-tiering soak (ISSUE 14): a fake-clock open-loop soak
+    whose working set is 10× the residency budget — overload pages
+    through the hibernate/wake delta-stream tier instead of shedding.
+    The row aborts on ANY shed, any lost ticket, any woken scenario not
+    bitwise-equal to its never-hibernated twin, or a failed
+    kill-mid-soak recovery audit; it reports hibernations/wakes,
+    measured wake-latency percentiles, and the re-hibernation delta
+    bytes as a fraction of the keyframe (the delta-stream paging
+    claim, measured)."""
+    import bench as bench_mod
+
+    g = 32 if quick else 128
+    row = bench_mod.bench_tiering(
+        grid=g, B=4 if quick else 8, steps=2 if quick else 4,
+        n_scenarios=20 if quick else 120)
+    return {"config": 12, "flow": "diffusion (per-scenario rates)",
+            "strategy": "scenario tiering: hibernate/wake paging soak "
+                        "(working set 10x budget, kill-mid-soak "
+                        "recovery)",
+            **row}
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11}
+           11: config11, 12: config12}
 
 
 def sweep_blocks(grid: int = 8192, dtype_name: str = "bfloat16") -> list:

@@ -541,8 +541,12 @@ def check_heavy_test(ctx: ModuleCtx):
 #: husk at best and a chain-corrupting overwrite at worst)
 CHECKPOINT_WRITERS = {"save_checkpoint", "save_checkpoint_sharded",
                       "stage_checkpoint_sharded", "write_chain_record"}
-#: receiver names that read as a CheckpointManager or a DeltaChain
-#: (`mgr.save(...)`, `chain.save(...)`)
+#: receiver names that read as a CheckpointManager, a DeltaChain or the
+#: scenario-tiering vault (`mgr.save(...)`, `chain.save(...)`,
+#: `vault.save(...)` / `tiering.hibernate` targets — ISSUE 14 extends
+#: the one-format discipline to hibernation writes: scenario state may
+#: only reach disk through the io/delta.py chain writers driven from
+#: the ensemble/tiering.py boundary)
 _MANAGERISH = None  # compiled lazily; module-level re import kept local
 
 
@@ -551,15 +555,22 @@ def _managerish():
     if _MANAGERISH is None:
         import re
 
-        _MANAGERISH = re.compile(r"(manager|mgr|ckpt|chain)", re.IGNORECASE)
+        _MANAGERISH = re.compile(r"(manager|mgr|ckpt|chain|vault|tiering)",
+                                 re.IGNORECASE)
     return _MANAGERISH
 
 
 def _save_boundary_module(ctx: ModuleCtx) -> bool:
-    """io/checkpoint.py, io/sharded.py, io/delta.py and the resilience
-    package are the supervisor/flush boundaries the rule exempts."""
+    """io/checkpoint.py, io/sharded.py, io/delta.py, the resilience
+    package and ensemble/tiering.py (ISSUE 14: the hibernate/wake
+    paging layer drives the delta-chain writers — the ONE sanctioned
+    place a scenario's state is written outside a checkpoint) are the
+    supervisor/flush boundaries the rule exempts."""
     parts = ctx.resolved_parts
     if "resilience" in parts:
+        return True
+    if (len(parts) >= 2 and parts[-2] == "ensemble"
+            and parts[-1] == "tiering.py"):
         return True
     return (len(parts) >= 2 and parts[-2] == "io"
             and parts[-1] in ("checkpoint.py", "sharded.py", "delta.py"))

@@ -346,7 +346,11 @@ def _run_serve(args, space, model) -> int:
         substeps=args.substeps, buckets=buckets_for(8),
         max_queue=args.max_queue, compute_dtype=_compute_dtype(args),
         deadline_s=args.deadline_s, retry="solo",
-        compile_cache=_cache_spec(args, "auto"))
+        compile_cache=_cache_spec(args, "auto"),
+        # ISSUE 14: capacity-aware paging — overload hibernates to the
+        # vault instead of shedding (both flags or neither, validated)
+        residency_budget=args.residency_budget,
+        hibernate_dir=args.hibernate_dir)
     fleet_mode = (args.serve_services > 1
                   or args.serve_transport != "inproc")
     if fleet_mode:
@@ -376,6 +380,18 @@ def _run_serve(args, space, model) -> int:
             "batch_occupancy", "dispatches", "solo_retries",
             "recovered_failures", "quarantined", "loop_faults")},
     }
+    if args.residency_budget is not None:
+        # ISSUE 14 observability: the paging ledger + gauges (wakes,
+        # hibernations, wake-latency percentiles, residency cut)
+        st = svc.stats()
+        for k in ("hibernations", "rehibernations", "wakes",
+                  "wake_faults", "wake_latency_p50_s",
+                  "wake_latency_p99_s", "resident_scenarios",
+                  "resident_bytes", "residency_budget",
+                  "hibernated_scenarios", "hibernated_bytes"):
+            result[k] = st.get(k)
+        if fleet_mode:
+            result["wakes_by_member"] = st.get("wakes_by_member")
     if fleet_mode:
         result["member_faults"] = rep["member_faults"]
         result["readmitted"] = rep["readmitted"]
@@ -539,6 +555,15 @@ def cmd_run(args) -> int:
         if args.deadline_s is not None and args.deadline_s <= 0:
             raise SystemExit(
                 f"--deadline-s={args.deadline_s} must be positive")
+        if (args.residency_budget is None) != (args.hibernate_dir is None):
+            raise SystemExit(
+                "scenario tiering needs BOTH --residency-budget and "
+                "--hibernate-dir (or neither)")
+        if args.residency_budget is not None \
+                and args.residency_budget < 1:
+            raise SystemExit(
+                f"--residency-budget={args.residency_budget} needs "
+                ">= 1 byte")
     else:
         for flag, val, default in (
                 ("--arrival-rate", args.arrival_rate, None),
@@ -546,7 +571,9 @@ def cmd_run(args) -> int:
                 ("--max-queue", args.max_queue, 64),
                 ("--serve-scenarios", args.serve_scenarios, 64),
                 ("--serve-services", args.serve_services, 1),
-                ("--serve-transport", args.serve_transport, "inproc")):
+                ("--serve-transport", args.serve_transport, "inproc"),
+                ("--residency-budget", args.residency_budget, None),
+                ("--hibernate-dir", args.hibernate_dir, None)):
             if val != default:
                 raise SystemExit(
                     f"{flag} configures the always-on serving loop; "
@@ -921,6 +948,18 @@ def main(argv: Optional[list[str]] = None) -> int:
     run.add_argument("--max-queue", type=int, default=64,
                      help="admission-queue bound: submissions beyond "
                      "this shed with ServiceOverloaded (default 64)")
+    run.add_argument("--residency-budget", type=int, default=None,
+                     metavar="BYTES",
+                     help="scenario-tiering residency budget (ISSUE "
+                     "14): scenario state bytes allowed resident; "
+                     "overload beyond it HIBERNATES scenarios to "
+                     "--hibernate-dir (keyframe+delta chains, TJ1 "
+                     "lifecycle journal) and wakes them as capacity "
+                     "frees — sheds happen only when the hibernation "
+                     "tier itself is exhausted")
+    run.add_argument("--hibernate-dir", default=None, metavar="DIR",
+                     help="vault directory for the hibernation tier "
+                     "(required with --residency-budget)")
     run.add_argument("--mesh", default=None,
                      help="LxC device mesh for sharded execution "
                      "(e.g. 4x1, 2x4); omit for serial")

@@ -30,7 +30,15 @@ compile cache.
                   wire protocol (``FleetSupervisor(member_transport=
                   "process")``): worker entrypoint, supervisor-side
                   client proxy, real-process and in-memory-loopback
-                  spawners.
+                  spawners;
+- ``tiering``   — scenario hibernate/wake paging (ISSUE 14):
+                  ``ScenarioTiering`` pages idle scenarios to
+                  keyframe+delta chains (PR 6 format) with a TJ1
+                  lifecycle journal, behind
+                  ``AsyncEnsembleService(residency_budget=,
+                  hibernate_dir=)`` / ``FleetSupervisor(...)`` —
+                  overload degrades to bounded wake latency instead of
+                  sheds.
 
 See docs/DESIGN.md "Ensemble serving" / "Always-on serving" / "Fleet
 supervision" for why the batch axis sits OUTSIDE the mesh axes and how
@@ -54,6 +62,8 @@ from .scheduler import (DEFAULT_BUCKETS, DispatchTimeout,
                         TicketNotMigratable, buckets_for)
 from .service import (AsyncEnsembleService, EnsembleService,
                       ServiceOverloaded, run_soak)
+from .tiering import (HibernationError, ScenarioTiering,
+                      scenario_nbytes)
 from .wire import FrameConn, RemoteError, WireClosed, WireError, WireTimeout
 
 __all__ = [
@@ -72,6 +82,9 @@ __all__ = [
     "EnsembleSpace",
     "ServiceOverloaded",
     "TicketExpired",
+    "HibernationError",
+    "ScenarioTiering",
+    "scenario_nbytes",
     "FrameConn",
     "RemoteError",
     "WireClosed",

@@ -76,6 +76,7 @@ from .journal import (TicketJournal, journal_path, model_from_meta,
                       model_meta, replay, space_from_record, space_payload)
 from .scheduler import TicketExpired, TicketNotMigratable
 from .service import AsyncEnsembleService, ServiceOverloaded
+from .tiering import HibernationError, ScenarioTiering, scenario_nbytes
 from .wire import WireError
 
 __all__ = ["AutoscalePolicy", "FleetSupervisor", "MemberFailure"]
@@ -186,6 +187,9 @@ class FleetSupervisor:
                  heartbeat_deadline_s: float = 2.0,
                  rpc_deadline_s: float = 30.0,
                  member_env: Optional[dict] = None,
+                 residency_budget: Optional[int] = None,
+                 hibernate_dir: Optional[str] = None,
+                 hibernate_budget: Optional[int] = None,
                  **member_kwargs):
         if services < 1:
             raise ValueError(f"services={services} must be >= 1")
@@ -261,6 +265,33 @@ class FleetSupervisor:
         self._journal_results = bool(journal_results)
         if journal_dir is not None:
             self.journal = TicketJournal(journal_path(journal_dir))
+        #: ISSUE 14 — fleet-level scenario tiering: when every member
+        #: refuses (or the fleet residency budget is exhausted) a
+        #: submission HIBERNATES to the vault instead of shedding;
+        #: tick() wakes hibernated scenarios FIFO onto the
+        #: structure-affine member as capacity frees. ServiceOverloaded
+        #: fires only when the hibernation tier itself is exhausted.
+        if (residency_budget is None) != (hibernate_dir is None):
+            raise ValueError(
+                "scenario tiering needs BOTH residency_budget and "
+                "hibernate_dir (or neither)")
+        self.tiering: Optional[ScenarioTiering] = (
+            ScenarioTiering(hibernate_dir,
+                            residency_budget=residency_budget,
+                            hibernate_budget=hibernate_budget,
+                            clock=clock, counter=self.counter)
+            if residency_budget is not None else None)
+        #: hibernated fleet tickets (under ``_cv``): ticket →
+        #: (model, steps, skey, submitted_at) — the state itself lives
+        #: ONLY in the vault chain (+ the fleet journal's submit
+        #: record): paging a scenario out genuinely frees its memory
+        self._hib_meta: dict = {}
+        #: wake placements per member id — the per-member attribution
+        #: of the paging tier (m<slot>g<gen> keys)
+        self._wakes_by_member: dict = {}
+        #: (stat signature, JournalState) — the journal-fallback wake
+        #: path's replay cache (see _journal_state_fallback)
+        self._journal_fallback_cache: Optional[tuple] = None
         #: counters of members that were fenced or retired — folded
         #: into stats() so fleet-level metrics never undercount the
         #: work a dead member did before dying
@@ -272,6 +303,17 @@ class FleetSupervisor:
         self._last_shed = 0
         self._stop_flag = False
         self._stopped = False
+        #: spawn requests a previous tick failed to fulfill (a raising
+        #: spawner) — retried at the next tick, so a transient spawn
+        #: failure can never permanently shrink the fleet below its
+        #: configured capacity
+        self._pending_spawns: list = []
+        #: fenced members whose DRAIN was deferred because their
+        #: replacement spawn failed and no live member remained — the
+        #: drain completes once the retried spawn installs, so the
+        #: fenced member's tickets re-admit instead of resolving as
+        #: MemberFailure for want of a one-tick-late candidate
+        self._pending_fences: list = []
         #: a simulated process kill: tick() becomes a no-op, so nothing
         #: is harvested (or journaled) after the "crash"
         self._abandoned = False
@@ -288,7 +330,11 @@ class FleetSupervisor:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def _spawn_locked(self, slot: int, gen: int) -> _Member:
+    def _make_member(self, slot: int, gen: int) -> _Member:
+        """Build one member WITHOUT touching fleet state — safe to run
+        outside the fleet lock (ISSUE 14 satellite: a process member's
+        spawn+connect takes seconds, and under the lock it stalled
+        every submit/poll for the duration)."""
         sid = f"m{slot}g{gen}"
         if self._transport == "inproc":
             svc = AsyncEnsembleService(self.model, service_id=sid,
@@ -311,10 +357,22 @@ class FleetSupervisor:
             # observability: how many times this fleet replaced a
             # member in place (fence → gen+1)
             self.counter.bump("respawns")
-        m = _Member(service=svc, slot=slot, gen=gen,
-                    progress_t=self._clock())
-        self._members[slot] = m
+        return _Member(service=svc, slot=slot, gen=gen,
+                       progress_t=self._clock())
+
+    def _install_locked(self, m: _Member) -> _Member:
+        self._members[m.slot] = m
         return m
+
+    def _spawn_locked(self, slot: int, gen: int) -> _Member:
+        """Spawn + install in one step — the constructor/recovery path
+        (no traffic contends for the lock yet). The supervision tick
+        spawns through ``_make_member`` OUTSIDE the lock instead."""
+        # analysis: ignore[blocking-under-lock] — constructor/recovery
+        # only: no client traffic exists yet, so nothing contends for
+        # the fleet lock during these spawns; every LIVE spawn (fence
+        # respawn, autoscale up) runs through tick()'s unlocked phase
+        return self._install_locked(self._make_member(slot, gen))
 
     def stop(self) -> None:
         """Drain and stop: members drain their queues (every pending
@@ -328,6 +386,10 @@ class FleetSupervisor:
             self._cv.notify_all()
         if t is not None:
             t.join()
+        # the paging drain comes FIRST: hibernated tickets wake onto
+        # members that are still pumping, so the member drains below
+        # resolve them like any other queued work
+        self._drain_hibernated()
         with self._cv:
             members = [m for m in self._members.values()
                        if not m.dead and not m.fenced]
@@ -338,6 +400,8 @@ class FleetSupervisor:
             self._stopped = True
             if self.journal is not None:
                 self.journal.close()
+            if self.tiering is not None:
+                self.tiering.close()
             remaining = list(self._members.values())
         if self._transport != "inproc":
             # wire teardown AFTER the final harvest: the drain RPC in
@@ -374,6 +438,10 @@ class FleetSupervisor:
         with self._cv:
             if self.journal is not None:
                 self.journal.close()
+            if self.tiering is not None:
+                # like the journal: the vault is the only survivor,
+                # exactly as a process kill would leave it
+                self.tiering.close()
 
     def __enter__(self) -> "FleetSupervisor":
         return self
@@ -408,9 +476,14 @@ class FleetSupervisor:
         m_model = self.model if model is None else model
         n = self.default_steps if steps is None else int(steps)
         skey = structure_key(m_model, space) + (n,)
+        nbytes = scenario_nbytes(space)
+        pressure = (self.tiering is not None
+                    and self.tiering.pressure(nbytes))
         with self._cv:
             order = self._candidates_locked(skey)
             last: Optional[ServiceOverloaded] = None
+            if pressure:
+                order = []  # the residency budget pages before routing
             for mem in order:
                 try:
                     # analysis: ignore[blocking-under-lock] — admission
@@ -434,20 +507,64 @@ class FleetSupervisor:
                                submitted_at=self._clock())
                 self._route[ticket] = route
                 self._journal_submit_locked(ticket, route)
+                if self.tiering is not None:
+                    self.tiering.admit(ticket, nbytes)
                 return ticket
-            self.counter.bump("shed")
-            depth = sum(m.service.scheduler.pending_count()
-                        for m in order)
-            self._journal_append_locked("shed", {
-                "depth": depth,
-                "members": [m.service_id for m in order]})
+            if self.tiering is not None \
+                    and self.tiering.room_for(nbytes):
+                # capacity-aware paging (ISSUE 14): every member
+                # refused (or the residency budget is exhausted) — the
+                # arrival HIBERNATES instead of shedding; tick() wakes
+                # it onto the affinity member as capacity frees. The
+                # fleet journal's submit record (full state) is the
+                # wake path's last-resort source.
+                ticket = next(self._ids)
+                self._journal_submit_hibernated_locked(
+                    ticket, space, m_model, n)
+                self._hib_meta[ticket] = (m_model, n, skey,
+                                          self._clock())
+            else:
+                ticket = None
+                self.counter.bump("shed")
+                depth = sum(m.service.scheduler.pending_count()
+                            for m in order)
+                self._journal_append_locked("shed", {
+                    "depth": depth,
+                    "members": [m.service_id for m in order]})
+        if ticket is None:
+            reason = ("hibernation tier exhausted"
+                      if self.tiering is not None
+                      else "every member refused")
             raise ServiceOverloaded(
-                "fleet admission shed — every member refused"
-                + (f" (last: {last})" if last is not None else
-                   " (no routable member)"),
+                f"fleet admission shed — {reason}"
+                + (f" (last: {last})" if last is not None else ""),
                 queue_depth=depth,
                 retry_after_s=(last.retry_after_s if last is not None
                                else self._tick_interval))
+        # the chain write happens OUTSIDE the fleet lock: paging I/O
+        # must not stall every submit/poll (the vault serializes
+        # paging operations against each other only)
+        try:
+            self.tiering.hibernate(ticket, space, m_model, n,
+                                   submitted_at=self._clock(), skey=skey)
+        except (OSError, ValueError) as e:
+            # the vault is unwritable: the journaled submit record must
+            # not become a forever-unresolved ghost — journal the
+            # terminal (the replay audit stays complete), drop the
+            # registration, and refuse the admission observably (the
+            # caller still holds its state)
+            with self._cv:
+                self._hib_meta.pop(ticket, None)
+                self._journal_append_locked("quarantined", {
+                    "ticket": ticket, "service_id": "hibernated",
+                    "steps": n, "error": type(e).__name__,
+                    "detail": f"hibernation write failed: {e}"})
+                self.counter.bump("shed")
+            raise ServiceOverloaded(
+                f"fleet admission shed — hibernation write failed: {e}",
+                queue_depth=0,
+                retry_after_s=self._tick_interval) from e
+        return ticket
 
     def _candidates_locked(self, skey) -> list[_Member]:
         """Routable members, preferred-first: the structure hash picks
@@ -468,13 +585,16 @@ class FleetSupervisor:
         return [preferred] + rest
 
     def poll(self, ticket: int):
-        """(space, Report) when resolved, None while outstanding;
-        raises the ticket's quarantine/expiry/member error. Terminal
-        outcomes are journaled at first observation (the harvest seam),
-        then popped — the collected-ticket contract of the scheduler."""
+        """(space, Report) when resolved, None while outstanding (a
+        HIBERNATED ticket polls None exactly like a queued one); raises
+        the ticket's quarantine/expiry/member error. Terminal outcomes
+        are journaled at first observation (the harvest seam), then
+        popped — the collected-ticket contract of the scheduler."""
         with self._cv:
             if ticket in self._resolved:
                 res = self._resolved.pop(ticket)
+            elif ticket in self._hib_meta:
+                return None  # paged out; tick() wakes it
             else:
                 route = self._route.get(ticket)
                 if route is None:
@@ -565,15 +685,36 @@ class FleetSupervisor:
             except Exception:
                 m.service.scheduler.counter.bump("loop_faults")
                 did = True
+        # tick() wakes hibernated scenarios (ISSUE 14); a wake or a
+        # hibernated-ticket resolution is pump WORK — result()'s
+        # manual-mode progress check must see it (plain GIL-atomic
+        # counter reads, same discipline as _progress_sig)
+        c = self.counter
+        before = c.wakes + c.expired + c.quarantined
         self.tick()
-        return did
+        return did or (self.tiering is not None
+                       and c.wakes + c.expired + c.quarantined > before)
 
     # -- supervision ---------------------------------------------------------
 
     def tick(self) -> None:
         """One supervision pass: harvest resolved tickets into the
         fleet (journaling terminals), health-check and fence failed
-        members, advance drain-before-retire, evaluate autoscaling.
+        members, spawn replacements, advance drain-before-retire,
+        evaluate autoscaling, wake hibernated scenarios into freed
+        capacity.
+
+        Member SPAWNS happen OUTSIDE the fleet lock (ISSUE 14
+        satellite — the PR 13 remainder): a process member's
+        spawn+connect takes seconds, and under the lock it stalled
+        every submit/poll for the duration. The tick is three phases:
+        (1) under the lock — harvest, mark fences (the fenced member
+        stops routing immediately), collect spawn requests; (2) no
+        lock — build the replacement members; (3) under the lock —
+        install them and drain the fenced members (harvest what
+        resolved, migrate what is queued, re-admit the rest from the
+        fleet's stored state). Between (1) and (3) admissions proceed
+        on the surviving members.
 
         Retired members are STOPPED after the lock is released: stop()
         joins the member's pump thread (and in manual mode force-drains
@@ -592,11 +733,55 @@ class FleetSupervisor:
             if self._abandoned:
                 return  # a simulated kill: supervision is dead
             self._harvest_locked()
-            self._health_check_locked()
+            to_fence, spawn_reqs = self._health_check_locked()
+            to_fence = self._pending_fences + to_fence
+            self._pending_fences = []
+            spawn_reqs = self._pending_spawns + spawn_reqs
+            self._pending_spawns = []
             retired = self._advance_retirements_locked()
             if self._policy is not None and not self._stop_flag:
-                self._autoscale_locked()
+                req = self._autoscale_locked()
+                if req is not None:
+                    spawn_reqs.append(req)
             self._cv.notify_all()
+        spawned = []
+        failed_reqs = []
+        for slot, gen in spawn_reqs:
+            try:
+                spawned.append(self._make_member(slot, gen))
+            # analysis: ignore[broad-except] — spawn isolation: one
+            # replacement failing to come up (a dead spawner, a full
+            # tmpdir) must not unwind the tick past the fence drain
+            # that resolves the dead member's tickets; counted, and
+            # RE-QUEUED for the next tick (the fenced slot was deleted
+            # from the membership, so nothing else would re-request it)
+            except Exception:
+                self.counter.bump("loop_faults")
+                failed_reqs.append((slot, gen))
+        with self._cv:
+            if not self._abandoned:
+                self._pending_spawns.extend(failed_reqs)
+                failed_slots = {slot for slot, _gen in failed_reqs}
+                for m in spawned:
+                    self._install_locked(m)
+                live = any(not x.fenced and not x.dead
+                           and not x.retiring
+                           for x in self._members.values())
+                for m, reason in to_fence:
+                    if (m.slot in failed_slots and not m.retiring
+                            and not live and not self._stop_flag):
+                        # its replacement never came up AND nobody
+                        # else can take its tickets: defer the drain
+                        # until the re-queued spawn lands, instead of
+                        # resolving everything as MemberFailure for
+                        # want of a one-tick-late candidate (at stop
+                        # there IS no next tick — the drain completes
+                        # now with counted MemberFailures)
+                        self._pending_fences.append((m, reason))
+                        continue
+                    self._complete_fence_locked(m, reason)
+                self._cv.notify_all()
+        self._wake_due()
         for m in retired:
             try:
                 m.service.stop()
@@ -730,6 +915,12 @@ class FleetSupervisor:
             # into a silently dropped ticket
             self._route.pop(ticket, None)
             self._resolved[ticket] = outcome
+            if self.tiering is not None:
+                # analysis: ignore[blocking-under-lock] — reclaiming a
+                # resolved ticket's chain (a few small files) must be
+                # atomic with its resolution, or a racing wake could
+                # resurrect a served scenario; the vault lock is a leaf
+                self.tiering.release(ticket)
             if not isinstance(outcome, Exception):
                 self.counter.record_latency(
                     self._clock() - route.submitted_at)
@@ -748,7 +939,14 @@ class FleetSupervisor:
         return (c.dispatches, c.scenarios, c.quarantined,
                 c.recovered_failures)
 
-    def _health_check_locked(self) -> None:
+    def _health_check_locked(self) -> tuple[list, list]:
+        """Mark failed members fenced and collect what the tick must do
+        next: returns ``(to_fence, spawn_requests)`` — the fence DRAIN
+        and the replacement SPAWN happen in the tick's later phases
+        (the spawn outside the lock), but from this moment the marked
+        member takes no routing and no harvest."""
+        to_fence: list = []
+        spawn_reqs: list = []
         now = self._clock()
         for m in list(self._members.values()):
             if m.fenced:
@@ -786,17 +984,21 @@ class FleetSupervisor:
                           "pending (supervision deadline "
                           f"{self._supervision_deadline}s)")
             if reason is not None:
-                self._fence_and_restart_locked(m, reason)
+                req = self._fence_locked(m, reason)
+                to_fence.append((m, reason))
+                if req is not None:
+                    spawn_reqs.append(req)
                 continue
             if (self._fence_on_ladder_bottom and not m.retiring
                     and m.service.scheduler.degraded_from is not None
                     and m.service.scheduler.DEGRADE_TO.get(
                         m.service.scheduler.executor.impl) is None):
                 # the pump is alive — drain out, never double-dispatch
-                self._fence_live_locked(
+                spawn_reqs.append(self._fence_live_locked(
                     m, "degradation ladder bottomed out (from "
                     f"{m.service.scheduler.degraded_from!r} to "
-                    f"{m.service.scheduler.executor.impl!r})")
+                    f"{m.service.scheduler.executor.impl!r})"))
+        return to_fence, spawn_reqs
 
     #: the member-counter fields stats() aggregates — absorbed from a
     #: member at fence/retire time so its work never vanishes from the
@@ -824,41 +1026,50 @@ class FleetSupervisor:
             classification="transient", service_id=m.service_id))
         self.counter.bump("member_faults")
 
-    def _fence_and_restart_locked(self, m: _Member, reason: str) -> None:
-        """The failure-domain boundary for a member whose pump can no
-        longer make progress (dead thread / wedge): fence it, log the
-        kind="member" FailureEvent, start its replacement (same slot,
-        next generation), then move every ticket it held — harvest what
-        resolved, migrate what is still queued, re-admit from the
-        fleet's stored state what was claimed/launched (the old pump
-        cannot finish it; if a wedged thread later unwedges, its
-        results land in an abandoned scheduler nobody reads — the
-        fleet's resolution stays exactly-once) — and abandon the old
-        pump."""
+    def _fence_locked(self, m: _Member, reason: str
+                      ) -> Optional[tuple]:
+        """Phase-1 fencing for a member whose pump can no longer make
+        progress (dead thread / wedge): mark it fenced (no routing, no
+        harvest — from this instant), log the kind="member"
+        FailureEvent, and return the replacement spawn request
+        ``(slot, gen+1)`` the tick fulfills OUTSIDE the lock. The drain
+        (``_complete_fence_locked``) runs after the replacement is
+        installed, so re-admission always has a candidate."""
         m.fenced = True
-        sid = m.service_id
         self._member_event_locked(m, reason)
         warnings.warn(
-            f"fleet member {sid} fenced ({reason}); restarting fresh "
-            f"as m{m.slot}g{m.gen + 1}", RuntimeWarning)
-        replacement = None
-        if not m.retiring:
-            replacement = self._spawn_locked(m.slot, m.gen + 1)
+            f"fleet member {m.service_id} fenced ({reason}); "
+            f"restarting fresh as m{m.slot}g{m.gen + 1}",
+            RuntimeWarning)
+        if m.retiring:
+            return None
+        return (m.slot, m.gen + 1)
+
+    def _complete_fence_locked(self, m: _Member, reason: str) -> None:
+        """Phase-3 fencing: move every ticket the fenced member held —
+        harvest what resolved, migrate what is still queued, re-admit
+        from the fleet's stored state what was claimed/launched (the
+        old pump cannot finish it; if a wedged thread later unwedges,
+        its results land in an abandoned scheduler nobody reads — the
+        fleet's resolution stays exactly-once) — and abandon the old
+        pump."""
         self._drain_member_locked(m, reason)
         self._absorb_counters_locked(m)
         m.service.abandon()
-        if replacement is None and m.slot in self._members \
-                and self._members[m.slot] is m:
+        if m.slot in self._members and self._members[m.slot] is m:
+            # no replacement was installed over this slot (a retiring
+            # member, or its spawn failed — the next tick re-fences)
             del self._members[m.slot]
 
-    def _fence_live_locked(self, m: _Member, reason: str) -> None:
+    def _fence_live_locked(self, m: _Member, reason: str) -> tuple:
         """The failure-domain boundary for a member whose pump still
         WORKS but whose engine is no longer trusted (ladder bottom):
         drain-out instead of kill — intake stops (retiring), a fresh
-        replacement starts in a NEW slot, queued tickets migrate, and
-        in-flight batches FINISH on the old member before it is removed
-        (re-admitting them would double-dispatch scenarios a live pump
-        is still computing)."""
+        replacement starts in a NEW slot (spawned outside the lock;
+        the returned request is the tick's to fulfill), queued tickets
+        migrate, and in-flight batches FINISH on the old member before
+        it is removed (re-admitting them would double-dispatch
+        scenarios a live pump is still computing)."""
         m.retiring = True
         m.retire_kind = "fence"
         self._member_event_locked(m, reason)
@@ -866,8 +1077,8 @@ class FleetSupervisor:
             f"fleet member {m.service_id} draining out ({reason}); "
             "replacement starts fresh on the configured impl",
             RuntimeWarning)
-        self._spawn_locked(next(self._slot_ids), 0)
         self._migrate_queued_locked(m, reason)
+        return (next(self._slot_ids), 0)
 
     def _drain_member_locked(self, m: _Member, reason: str) -> None:
         for ticket, route in list(self._route.items()):
@@ -1040,15 +1251,258 @@ class FleetSupervisor:
                 "ticket": ticket, "from": m.service_id,
                 "to": order[0].service_id, "reason": reason})
 
+    # -- scenario tiering (ISSUE 14) -----------------------------------------
+
+    def _wake_due(self) -> int:
+        """Wake hibernated fleet tickets FIFO into freed capacity. The
+        chain restore runs OUTSIDE the fleet lock (paging I/O must not
+        stall submit/poll); the placement — structure-affine routing +
+        member submit — is atomic with the route table, so the woken
+        scenario lands on the member whose bucket runner is already
+        compiled. A wake that finds every member refusing goes back to
+        the HEAD of the queue (its chain is untouched). Hibernated
+        tickets past the member deadline resolve as ``TicketExpired``.
+        Returns wakes + resolutions performed."""
+        if self.tiering is None:
+            return 0
+        did = 0
+        while True:
+            nxt = self.tiering.peek_next()
+            if nxt is None:
+                return did
+            ticket, nbytes = nxt
+            with self._cv:
+                meta = self._hib_meta.get(ticket)
+                if meta is None:
+                    # a vault entry nothing routes to (e.g. recovery
+                    # found a chain whose fleet-journal ticket already
+                    # resolved): reclaim it
+                    # analysis: ignore[blocking-under-lock] — the
+                    # reclaim (a few small files + one journal line)
+                    # must be atomic with the bookkeeping check, or a
+                    # racing wake could resurrect the orphan; the
+                    # vault lock is a leaf
+                    self.tiering.drop(ticket)
+                    continue
+                model, steps, skey, submitted_at = meta
+                live = [m for m in self._members.values()
+                        if not m.fenced and not m.dead
+                        and not m.retiring]
+                room = (any(m.service.scheduler.pending_count()
+                            < self._max_queue for m in live)
+                        and self.tiering.fits(nbytes))
+                idle = not self._route and bool(live)
+                if not (room or idle):
+                    return did
+                ddl = self._member_kwargs.get("deadline_s")
+                if ddl is not None \
+                        and self._clock() - submitted_at > ddl:
+                    age = self._clock() - submitted_at
+                    self._resolve_hibernated_locked(ticket, TicketExpired(
+                        f"fleet ticket {ticket} expired after "
+                        f"{age:.3f}s in the hibernation tier (deadline "
+                        f"{ddl}s) — never dispatched"), steps)
+                    did += 1
+                    continue
+            try:
+                space, entry = self.tiering.wake(
+                    ticket, fallback=self._journal_state_fallback)
+            except HibernationError as e:
+                with self._cv:
+                    self._resolve_hibernated_locked(ticket, e, steps)
+                did += 1
+                continue
+            placed = self._place_woken(ticket, space, model, steps,
+                                       skey, submitted_at, nbytes,
+                                       bypass=False)
+            if not placed:
+                # every member refused mid-wake: back to the head; the
+                # next tick retries once capacity really freed
+                self.tiering.requeue(ticket, entry)
+                return did
+            did += 1
+
+    def _place_woken(self, ticket: int, space, model, steps: int,
+                     skey, submitted_at, nbytes: int,
+                     bypass: bool) -> bool:
+        """Route one woken scenario onto a live member and install its
+        route (atomic with the route table). ``bypass=True`` submits
+        scheduler-level (the stop()-drain path — an admitted ticket is
+        never shed by its own drain)."""
+        with self._cv:
+            if skey is None:
+                skey = structure_key(model, space) + (steps,)
+            for mem in self._candidates_locked(skey):
+                try:
+                    if bypass:
+                        # analysis: ignore[blocking-under-lock] — the
+                        # re-admission contract of _readmit_locked:
+                        # placement must be atomic with the route
+                        # table; members run inline_dispatch=False
+                        mt = mem.service.scheduler.submit(
+                            space, model, steps)
+                    else:
+                        # analysis: ignore[blocking-under-lock] — same
+                        # contract as submit()'s admission routing
+                        mt = mem.service.submit(space, model=model,
+                                                steps=steps)
+                except ServiceOverloaded:
+                    continue
+                except WireError:
+                    self.counter.bump("wire_errors")
+                    mem.dead = True
+                    continue
+                self._route[ticket] = _Route(
+                    member=mem, member_ticket=mt, space=space,
+                    model=model, steps=steps, submitted_at=submitted_at)
+                self._hib_meta.pop(ticket, None)
+                self.tiering.admit(ticket, nbytes)
+                sid = mem.service_id
+                self._wakes_by_member[sid] = \
+                    self._wakes_by_member.get(sid, 0) + 1
+                self._journal_append_locked("wake", {
+                    "ticket": ticket, "to": sid})
+                self._cv.notify_all()
+                return True
+            return False
+
+    def _resolve_hibernated_locked(self, ticket: int, err: Exception,
+                                   steps: int) -> None:
+        """Terminal outcome for a ticket still in the hibernation tier
+        (deadline expiry, an unwakeable chain, no member left at the
+        drain): journaled like any other terminal, counted, published
+        to ``_resolved`` — never silent."""
+        from ..resilience import FailureEvent
+
+        expired = isinstance(err, TicketExpired)
+        kind = "expired" if expired else "quarantined"
+        err.ticket = ticket
+        ev = FailureEvent(
+            step=steps, kind="expired" if expired else "hibernation",
+            detail=str(err), rolled_back_to=0, attempt=1,
+            wall_time_s=0.0, classification="deterministic",
+            ticket=ticket, service_id="hibernated")
+        err.failure_event = ev
+        self._journal_append_locked(kind, {
+            "ticket": ticket, "service_id": "hibernated",
+            "steps": steps, "error": type(err).__name__,
+            "detail": str(err)})
+        self._hib_meta.pop(ticket, None)
+        self._resolved[ticket] = err
+        self.counter.bump("expired" if expired else "quarantined")
+        # analysis: ignore[blocking-under-lock] — reclaiming the
+        # resolved ticket's chain must be atomic with its resolution
+        # (a racing wake could resurrect it); the vault lock is a leaf
+        # and the reclaim is a few small files + one journal line
+        self.tiering.drop(ticket)
+        self._cv.notify_all()
+
+    def _journal_state_fallback(self, ticket: int):
+        """The wake path's last resort (the ``wake_corrupt`` ladder's
+        middle rung): materialize the ticket's state from the fleet
+        journal's CRC-verified submit record. None without a journal —
+        the wake then fails LOUDLY (``HibernationError``). The replay
+        is cached on the file's stat signature: several fallback wakes
+        in one burst (a vault-wide corruption) scan and CRC the
+        journal once, not once per ticket."""
+        if self.journal is None:
+            return None
+        import os as _os
+
+        try:
+            st = _os.stat(self.journal.path)
+            sig = (st.st_mtime_ns, st.st_size)
+        except OSError:  # pragma: no cover - defensive
+            return None
+        cached = self._journal_fallback_cache
+        if cached is not None and cached[0] == sig:
+            state = cached[1]
+        else:
+            try:
+                state = replay(self.journal.path)
+            except (OSError, ValueError):  # pragma: no cover - defensive
+                return None
+            # analysis: ignore[unguarded-shared-mutation] — single
+            # writer by construction: fallbacks only run inside
+            # tiering.wake, which serializes under the vault lock (and
+            # taking the fleet lock here would invert the documented
+            # _cv → vault order); a stale one-tuple read is harmless
+            self._journal_fallback_cache = (sig, state)
+        rec = state.submits.get(ticket)
+        if rec is None or rec.arrays is None:
+            return None
+        warnings.warn(
+            f"waking ticket {ticket} from the fleet journal's submit "
+            "record (its hibernation chain did not verify)",
+            RuntimeWarning)
+        return space_from_record(rec)
+
+    def _drain_hibernated(self) -> None:
+        """stop()'s paging drain: every hibernated ticket wakes onto a
+        live member (scheduler-level submit — an admitted ticket is
+        never shed by its own drain) BEFORE the members themselves
+        drain, so the final harvest resolves everything; with no live
+        member left, the ticket resolves as a counted MemberFailure."""
+        if self.tiering is None:
+            return
+        while True:
+            nxt = self.tiering.peek_next()
+            if nxt is None:
+                return
+            ticket, nbytes = nxt
+            with self._cv:
+                meta = self._hib_meta.get(ticket)
+                if meta is None:
+                    # analysis: ignore[blocking-under-lock] — orphan
+                    # reclaim atomic with the bookkeeping check (see
+                    # _wake_due); the vault lock is a leaf
+                    self.tiering.drop(ticket)
+                    continue
+            model, steps, skey, submitted_at = meta
+            try:
+                space, _entry = self.tiering.wake(
+                    ticket, fallback=self._journal_state_fallback)
+            except HibernationError as e:
+                with self._cv:
+                    self._resolve_hibernated_locked(ticket, e, steps)
+                continue
+            if not self._place_woken(ticket, space, model, steps, skey,
+                                     submitted_at, nbytes, bypass=True):
+                with self._cv:
+                    self._resolve_hibernated_locked(
+                        ticket, MemberFailure(
+                            "no healthy member remains to wake "
+                            f"hibernated ticket {ticket} at stop",
+                            "hibernated"), steps)
+
+    def _journal_submit_hibernated_locked(self, ticket: int, space,
+                                          model, steps: int) -> None:
+        if self.journal is None:
+            return
+        # analysis: ignore[blocking-under-lock] — the documented
+        # journal-append-under-the-fleet-lock trade (see
+        # _journal_append_locked): the submit record must be ordered
+        # before any terminal for this ticket, and it doubles as the
+        # wake path's last-resort state source
+        meta, arrays = space_payload(space)
+        meta.update({
+            "ticket": ticket, "service_id": "hibernated",
+            "steps": steps, "model": model_meta(model)})
+        self._journal_append_locked("submit", meta, arrays)
+
     # -- autoscaling ---------------------------------------------------------
 
-    def _autoscale_locked(self) -> None:
+    def _autoscale_locked(self) -> Optional[tuple]:
+        """Evaluate the policy; a scale-up VOTE returns the spawn
+        request ``(slot, 0)`` for the tick to fulfill outside the lock
+        (the hysteresis/cooldown state advances at vote time, so the
+        policy is unchanged by where the spawn happens)."""
         p = self._policy
         live = [m for m in self._members.values()
                 if not m.fenced and not m.dead and not m.retiring]
         n = len(live)
         if n == 0:
-            return
+            return None
         depth = sum(m.service.scheduler.pending_count() for m in live)
         depth_frac = depth / (n * self._max_queue)
         shed_total = self.counter.shed
@@ -1068,7 +1522,7 @@ class FleetSupervisor:
         if self._cooldown > 0:
             self._cooldown -= 1
             self._up_ticks = self._down_ticks = 0
-            return
+            return None
         if overload:
             self._up_ticks += 1
             self._down_ticks = 0
@@ -1078,11 +1532,11 @@ class FleetSupervisor:
         else:
             self._up_ticks = self._down_ticks = 0
         if self._up_ticks >= p.scale_up_after and n < p.max_services:
-            self._spawn_locked(next(self._slot_ids), 0)
             self.counter.bump("scale_ups")
             self._cooldown = p.cooldown_ticks
             self._up_ticks = self._down_ticks = 0
-        elif self._down_ticks >= p.scale_down_after and n > p.min_services:
+            return (next(self._slot_ids), 0)
+        if self._down_ticks >= p.scale_down_after and n > p.min_services:
             # drain-before-retire: least-loaded member stops taking
             # intake; _advance_retirements_locked migrates + removes it
             victim = min(live, key=lambda m: (
@@ -1090,6 +1544,7 @@ class FleetSupervisor:
             victim.retiring = True
             self._cooldown = p.cooldown_ticks
             self._up_ticks = self._down_ticks = 0
+        return None
 
     # -- journal / recovery --------------------------------------------------
 
@@ -1124,6 +1579,16 @@ class FleetSupervisor:
         fleet = cls(model, journal_dir=journal_dir, **kwargs)
         with fleet._cv:
             fleet._ids = itertools.count(state.max_ticket() + 1)
+            # ISSUE 14: tickets that were HIBERNATED at the crash are
+            # re-admittable from their chains exactly like journaled
+            # tickets — they re-enter the hibernation tier (their
+            # state stays on disk; tick() wakes them as capacity
+            # frees) instead of being re-materialized here. In-flight
+            # hibernations (intent journaled, chain torn) resolve at
+            # wake time: verified prefix first, the fleet journal's
+            # submit record second, a loud HibernationError last.
+            hib = (fleet.tiering.recover(model)
+                   if fleet.tiering is not None else {})
             for t, rec in state.terminal.items():
                 if rec.kind == "served":
                     if rec.arrays is None:
@@ -1161,8 +1626,20 @@ class FleetSupervisor:
                         f"{rec.meta.get('detail', '')}")
                     err.ticket = t
                     fleet._resolved[t] = err
+            for t in [t for t in hib if t in state.terminal]:
+                # terminal wins: a vault entry for a ticket the fleet
+                # journal already resolved is a leftover — reclaim it
+                fleet.tiering.drop(t)
+                hib.pop(t)
             for t in state.unresolved():
                 rec = state.submits[t]
+                if t in hib:
+                    e = hib[t]
+                    fleet._hib_meta[t] = (
+                        e.model, e.steps or rec.meta.get(
+                            "steps", fleet.default_steps),
+                        None, fleet._clock())
+                    continue
                 # analysis: ignore[blocking-under-lock] — recovery
                 # replays before any client traffic exists (see above)
                 sp = space_from_record(rec)
@@ -1277,12 +1754,26 @@ class FleetSupervisor:
                 "wire_errors": snap["wire_errors"],
                 "wire_bytes_in": wire_in,
                 "wire_bytes_out": wire_out,
-                "pending": len(self._route),
+                # hibernated tickets are outstanding work too — a
+                # client holding one must see it pending
+                "pending": len(self._route) + len(self._hib_meta),
                 "degraded_from": degraded_from,
                 "intake_gated": gated,
                 "fleet": True,
                 "members": len(members),
                 "journal": (self.journal.path
                             if self.journal is not None else None),
+                # ISSUE 14: the paging tier's gauges + counters and
+                # the per-member wake attribution (m<slot>g<gen>)
+                **({"hibernations": snap["hibernations"],
+                    "rehibernations": snap["rehibernations"],
+                    "wakes": snap["wakes"],
+                    "wake_faults": snap["wake_faults"],
+                    "wake_latency_n": snap["wake_latency_n"],
+                    "wake_latency_p50_s": snap["wake_latency_p50_s"],
+                    "wake_latency_p99_s": snap["wake_latency_p99_s"],
+                    "wakes_by_member": dict(self._wakes_by_member),
+                    **self.tiering.stats()}
+                   if self.tiering is not None else {}),
                 "services": per,
             }

@@ -218,10 +218,16 @@ class MemberServer:
         """The loopback stand-in for ``SIGKILL``: close the serve
         connection out from under the loop — in-flight and future RPCs
         fail with the wire's typed errors, exactly like a peer that
-        died mid-write. Nothing is drained, nothing replies."""
+        died mid-write. Nothing is drained, nothing replies. The
+        member's pump thread dies too (``abandon`` — exit-NOW, no
+        drain): a SIGKILLed child loses every thread, and a loopback
+        "kill" that left a live in-process pump would keep dispatching
+        work — and consuming armed chaos faults — after the fleet
+        fenced it."""
         with self._lock:
             self._stopping = True
         self.conn.close()
+        self.service.abandon()
 
     def serve_forever(self) -> None:
         # the conn ALWAYS closes on the way out (even on a torn/corrupt

@@ -298,6 +298,28 @@ class EnsembleScheduler:
             self._dispatch_group(key)
         return ticket
 
+    def allocate_ticket(self) -> int:
+        """Reserve one ticket id WITHOUT queuing a scenario — the
+        capacity-aware paging overlay (ISSUE 14) hands these to
+        submissions it hibernates instead of enqueuing, so a client's
+        ticket namespace is one sequence whether its scenario went
+        resident or paged out (polling a hibernated ticket is the
+        overlay's job; the scheduler itself reports it unknown)."""
+        with self._lock:
+            return next(self._ids)
+
+    def queued_since(self, ticket: int) -> Optional[float]:
+        """The injectable-clock time a QUEUED ticket was submitted, or
+        None when it is not queued — the paging overlay reads it
+        before extracting a page-out victim, so a ticket's deadline
+        clock survives hibernation instead of restarting per cycle."""
+        with self._lock:
+            for q in self._queues.values():
+                for it in q:
+                    if it.ticket == ticket:
+                        return it.submitted_at
+            return None
+
     def pending_count(self) -> int:
         """Tickets submitted and not yet resolved (queued or in a
         dispatch) — the admission queue depth the async service bounds."""

@@ -46,11 +46,14 @@ from __future__ import annotations
 
 import threading
 import time
+import warnings
 from typing import Callable, Optional, Sequence
 
 from ..core.cellular_space import CellularSpace
 from ..resilience import inject, lockdep
-from .scheduler import (DEFAULT_BUCKETS, EnsembleScheduler, TicketExpired)
+from .scheduler import (DEFAULT_BUCKETS, EnsembleScheduler, TicketExpired,
+                        TicketNotMigratable)
+from .tiering import HibernationError, ScenarioTiering, scenario_nbytes
 
 
 class ServiceOverloaded(RuntimeError):
@@ -207,9 +210,16 @@ class AsyncEnsembleService:
                  windows: int = 1, donate: bool = True,
                  compile_cache: Optional[str] = "auto",
                  start: bool = True, poll_interval_s: float = 0.02,
-                 service_id: Optional[str] = None):
+                 service_id: Optional[str] = None,
+                 residency_budget: Optional[int] = None,
+                 hibernate_dir: Optional[str] = None,
+                 hibernate_budget: Optional[int] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue={max_queue} must be >= 1")
+        if (residency_budget is None) != (hibernate_dir is None):
+            raise ValueError(
+                "scenario tiering needs BOTH residency_budget and "
+                "hibernate_dir (or neither)")
         self.model = model
         self.default_steps = (model.num_steps if steps is None
                               else int(steps))
@@ -232,6 +242,28 @@ class AsyncEnsembleService:
             inline_dispatch=False, compile_cache=compile_cache,
             service_id=service_id)
         self.compile_cache = self.scheduler.compile_cache
+        self._clock = clock
+        #: ISSUE 14 — capacity-aware paging: with a residency budget
+        #: and a vault directory, admission overload HIBERNATES (the
+        #: LRU queued resident, else the new arrival) instead of
+        #: shedding; ServiceOverloaded fires only when the hibernation
+        #: tier itself is exhausted. The pump wakes hibernated
+        #: scenarios FIFO as capacity frees.
+        self.tiering: Optional[ScenarioTiering] = (
+            ScenarioTiering(hibernate_dir,
+                            residency_budget=residency_budget,
+                            hibernate_budget=hibernate_budget,
+                            clock=clock, counter=self.scheduler.counter)
+            if residency_budget is not None else None)
+        #: hibernated-ticket bookkeeping (mutated under ``_lock_cv``):
+        #: client ticket → (model, steps) while paged out; client
+        #: ticket → current scheduler ticket once woken (the alias a
+        #: wake creates — the client's ticket id never changes); client
+        #: ticket → the terminal error resolved while hibernated
+        #: (deadline expiry, an unwakeable chain)
+        self._hib_meta: dict = {}
+        self._woken: dict = {}
+        self._hib_resolved: dict = {}
         self._poll_interval = float(poll_interval_s)
         #: condition guarding the loop state below (its lock is the
         #: "dispatch lock" of this class for the shared-mutation rule);
@@ -286,7 +318,8 @@ class AsyncEnsembleService:
             if not self.pump_once(force=True):
                 with self._lock_cv:
                     idle = (self._inflight is None
-                            and self.scheduler.pending_count() == 0)
+                            and self.scheduler.pending_count() == 0
+                            and not self._tiering_pending())
                 if idle:
                     break
         with self._lock_cv:
@@ -350,6 +383,8 @@ class AsyncEnsembleService:
             f = st.take("admission", st.bump("admission"),
                         kinds=("queue_full",))
             forced = f is not None
+        if self.tiering is not None:
+            return self._submit_paged(space, m, n, forced)
         sched = self.scheduler
         # the scheduler's own lock makes depth-check + enqueue atomic
         # (its submit re-enters the RLock; inline_dispatch=False means
@@ -381,6 +416,228 @@ class AsyncEnsembleService:
             self._lock_cv.notify_all()
         return ticket
 
+    def _submit_paged(self, space: CellularSpace, model, steps: int,
+                      forced: bool) -> int:
+        """Capacity-aware paging admission (ISSUE 14): a submission
+        that fits the residency budget and the queue admits normally;
+        on pressure the LRU queued resident pages out to make room, and
+        when nothing is extractable the NEW arrival hibernates. The
+        only refusal left is an exhausted hibernation tier."""
+        sched = self.scheduler
+        nbytes = scenario_nbytes(space)
+        why = self.tiering.pressure(nbytes)
+        # an INJECTED pressure (residency_pressure / queue_full chaos)
+        # must exercise the hibernation path itself — the page-out
+        # shortcut would notice the budget actually fits and admit,
+        # silently skipping the seam under test
+        injected = forced or why == "injected"
+        ticket = None
+        if not injected and why is None:
+            with sched._lock:
+                depth = sched.pending_count()
+                gated = sched.intake_gated and depth > 0
+                if not gated and depth < self.max_queue:
+                    # analysis: ignore[blocking-under-lock] — same
+                    # contract as the unpaged admission: this scheduler
+                    # runs inline_dispatch=False (enqueue-only), and
+                    # depth-check + enqueue must be atomic
+                    ticket = sched.submit(space, model, steps)
+        if ticket is None and not injected and self._page_out(nbytes):
+            # room was made: admit (enqueue-only; a concurrent
+            # submitter racing into the freed slot is a bounded
+            # overshoot, not a correctness issue)
+            ticket = sched.submit(space, model, steps)
+        if ticket is not None:
+            self.tiering.admit(ticket, nbytes)
+            with self._lock_cv:
+                self._lock_cv.notify_all()
+            return ticket
+        # no extractable victim: the new arrival hibernates — unless
+        # even the hibernation tier is full, the one remaining shed
+        if not self.tiering.room_for(nbytes):
+            sched.counter.bump("shed")
+            depth = sched.pending_count()
+            raise ServiceOverloaded(
+                "submission shed — hibernation tier exhausted "
+                f"(hibernate_budget={self.tiering.hibernate_budget} "
+                "bytes); paging absorbed the overflow until now",
+                queue_depth=depth,
+                retry_after_s=self._retry_after(depth))
+        ticket = sched.allocate_ticket()
+        with self._lock_cv:
+            self._hib_meta[ticket] = (model, steps)
+        try:
+            self.tiering.hibernate(ticket, space, model, steps,
+                                   submitted_at=self._clock())
+        except (OSError, ValueError) as e:
+            # the vault is unwritable: the ticket was never handed out
+            # and the caller still holds its state — clean up the
+            # registration and refuse the admission observably
+            with self._lock_cv:
+                self._hib_meta.pop(ticket, None)
+            sched.counter.bump("shed")
+            raise ServiceOverloaded(
+                f"submission shed — hibernation write failed: {e}",
+                queue_depth=sched.pending_count(),
+                retry_after_s=self._retry_after(
+                    sched.pending_count())) from e
+        with self._lock_cv:
+            self._lock_cv.notify_all()
+        return ticket
+
+    def _page_out(self, needed: int) -> bool:
+        """Hibernate LRU queued residents until ``needed`` bytes fit
+        the budget AND a queue slot is free; False when no victim is
+        extractable (everything resident is claimed/launched — their
+        dispatches are about to free the room anyway)."""
+        sched = self.scheduler
+
+        def room() -> bool:
+            return (self.tiering.fits(needed)
+                    and sched.pending_count() < self.max_queue)
+
+        for t in self.tiering.lru_candidates():
+            if room():
+                return True
+            # mark the victim hibernated-in-progress BEFORE extracting:
+            # between extract (the scheduler forgets the ticket) and
+            # the vault commit, a concurrent poll() of the victim must
+            # see "pending" (None), never a KeyError on a live ticket
+            with self._lock_cv:
+                target = self._woken.pop(t, t)
+                placeholder = t not in self._hib_meta
+                if placeholder:
+                    self._hib_meta[t] = (None, None)
+            since = sched.queued_since(target)
+            try:
+                vspace, vmodel, vsteps = sched.extract_ticket(target)
+            except (TicketNotMigratable, KeyError):
+                with self._lock_cv:
+                    if target != t:
+                        self._woken[t] = target
+                    if placeholder:
+                        self._hib_meta.pop(t, None)
+                continue
+            with self._lock_cv:
+                self._hib_meta[t] = (vmodel, vsteps)
+            try:
+                # the victim's deadline clock survives the page-out:
+                # its ORIGINAL queued-since time is what the
+                # hibernated-expiry check ages against
+                self.tiering.hibernate(
+                    t, vspace, vmodel, vsteps,
+                    submitted_at=(self._clock() if since is None
+                                  else since))
+            except (OSError, ValueError) as e:
+                # the vault is unwritable: the extracted state in hand
+                # is the victim's ONLY copy — put it straight back in
+                # the scheduler (new ticket, aliased) and stop paging;
+                # losing the victim is never an acceptable outcome
+                t2 = sched.submit(vspace, vmodel, vsteps)
+                with self._lock_cv:
+                    self._woken[t] = t2
+                sched.counter.bump("loop_faults")
+                warnings.warn(
+                    f"page-out of ticket {t} failed ({e}); the victim "
+                    "was re-queued and paging is disabled for this "
+                    "admission", RuntimeWarning)
+                return False
+        return room()
+
+    def _wake_due(self, draining: bool = False) -> int:
+        """Wake FIFO hibernated scenarios while there is room (queue
+        slot + residency budget), the service is idle (an idle service
+        always wakes one — a scenario must eventually run even when the
+        budget is smaller than its state), or a drain is forcing.
+        Hibernated tickets past their deadline resolve as
+        ``TicketExpired`` here, at the same cadence the scheduler
+        expires queued ones. Returns resolutions + wakes performed."""
+        from ..resilience import FailureEvent
+
+        sched = self.scheduler
+        did = 0
+        while True:
+            nxt = self.tiering.peek_next()
+            if nxt is None:
+                return did
+            ticket, nbytes = nxt
+            depth = sched.pending_count()
+            with self._lock_cv:
+                idle = self._inflight is None and depth == 0
+            room = depth < self.max_queue and self.tiering.fits(nbytes)
+            # the health gate applies to wakes too: while the
+            # degradation ladder is mid-fall with backlog unproven,
+            # paging scenarios back in would bypass exactly the gate
+            # admission enforces (an idle gated service still wakes
+            # one — its health probe, same as admission)
+            gated = sched.intake_gated and depth > 0
+            if not draining and (gated or not (room or idle)):
+                return did
+            entry = self.tiering.entry(ticket)
+            if entry is None:  # pragma: no cover - racing drop
+                continue
+            ddl = sched.ticket_deadline_s
+            if ddl is not None \
+                    and self._clock() - entry.submitted_at > ddl:
+                age = self._clock() - entry.submitted_at
+                err: Exception = TicketExpired(
+                    f"ticket {ticket} expired after {age:.3f}s in the "
+                    f"hibernation tier (deadline {ddl}s) — never "
+                    "dispatched")
+                ev = FailureEvent(
+                    step=entry.steps, kind="expired", detail=str(err),
+                    rolled_back_to=0, attempt=1, wall_time_s=0.0,
+                    classification="deterministic", ticket=ticket,
+                    service_id=self.service_id)
+                err.ticket = ticket
+                err.failure_event = ev
+                sched.expired_log.append(ev)
+                sched.counter.bump("expired")
+                self._resolve_hibernated(ticket, err)
+                did += 1
+                continue
+            try:
+                space, entry = self.tiering.wake(ticket)
+            except HibernationError as e:
+                e.ticket = ticket
+                ev = FailureEvent(
+                    step=entry.steps, kind="hibernation", detail=str(e),
+                    rolled_back_to=0, attempt=1, wall_time_s=0.0,
+                    classification="deterministic", ticket=ticket,
+                    service_id=self.service_id)
+                e.failure_event = ev
+                sched.quarantine_log.append(ev)
+                sched.counter.bump("quarantined")
+                self._resolve_hibernated(ticket, e)
+                did += 1
+                continue
+            t2 = sched.submit(space, entry.model, entry.steps)
+            self.tiering.admit(ticket, entry.nbytes)
+            with self._lock_cv:
+                self._woken[ticket] = t2
+                self._lock_cv.notify_all()
+            did += 1
+
+    def _resolve_hibernated(self, ticket: int, err: Exception) -> None:
+        self.tiering.drop(ticket)
+        with self._lock_cv:
+            self._hib_resolved[ticket] = err
+            self._hib_meta.pop(ticket, None)
+            self._lock_cv.notify_all()
+
+    def _resolve_tiering(self, ticket: int) -> None:
+        """A tiered ticket reached its terminal outcome through the
+        scheduler: free its residency, reclaim its chain, drop the
+        wake alias."""
+        self.tiering.release(ticket)
+        with self._lock_cv:
+            self._woken.pop(ticket, None)
+            self._hib_meta.pop(ticket, None)
+
+    def _tiering_pending(self) -> bool:
+        return (self.tiering is not None
+                and self.tiering.hibernated_count() > 0)
+
     def _retry_after(self, depth: int) -> float:
         """Drain-time estimate: queue depth x the recent per-scenario
         busy time, floored at the pump interval. O(1) on purpose — this
@@ -393,10 +650,36 @@ class AsyncEnsembleService:
         return max(depth * per, self._poll_interval)
 
     def poll(self, ticket: int):
-        """(space, Report) when served, None while in flight; raises
-        the ticket's quarantine/expiry error. Never dispatches on the
-        caller's thread — the loop owns the device."""
-        return self.scheduler.poll(ticket, pump=False)
+        """(space, Report) when served, None while in flight (or
+        hibernated — a paged-out ticket polls None exactly like a
+        queued one); raises the ticket's quarantine/expiry error.
+        Never dispatches on the caller's thread — the loop owns the
+        device."""
+        if self.tiering is None:
+            return self.scheduler.poll(ticket, pump=False)
+        with self._lock_cv:
+            if ticket in self._hib_resolved:
+                raise self._hib_resolved.pop(ticket)
+            mapped = self._woken.get(ticket, ticket)
+            hibernated = (ticket in self._hib_meta
+                          and ticket not in self._woken)
+        if hibernated:
+            return None
+        try:
+            res = self.scheduler.poll(mapped, pump=False)
+        except Exception as e:
+            if mapped != ticket:
+                # the client holds ITS ticket id, not the wake alias:
+                # a quarantine/expiry raised under the alias must
+                # correlate with the ticket the client submitted
+                e.ticket = ticket
+            self._resolve_tiering(ticket)
+            raise
+        if res is None:
+            self.tiering.touch(ticket)
+            return None
+        self._resolve_tiering(ticket)
+        return res
 
     def result(self, ticket: int, timeout: Optional[float] = None):
         """Block until ``ticket`` resolves (the loop serves it);
@@ -441,6 +724,8 @@ class AsyncEnsembleService:
                 "running": self._thread is not None,
                 "loop_errors": len(self.loop_errors),
             })
+        if self.tiering is not None:
+            out.update(self.tiering.stats())
         return out
 
     # -- the pump ------------------------------------------------------------
@@ -472,6 +757,17 @@ class AsyncEnsembleService:
             if f is not None:
                 raise inject.InjectedFault(
                     "injected dispatch-thread exception")
+        woke = 0
+        if self.tiering is not None:
+            # wake hibernated scenarios into the freed capacity BEFORE
+            # claiming the next batch, so a wake rides this very pump.
+            # Only a STOP drain overrides the residency budget — a
+            # manual-mode result() also pumps with force=True, and it
+            # must page scenarios in one at a time, not flood the
+            # whole tier back into memory
+            with self._lock_cv:
+                stopping = self._stop
+            woke = self._wake_due(draining=force and stopping)
         flight = self.scheduler.launch_due(force=force)
         with self._lock_cv:
             prev, self._inflight = self._inflight, flight
@@ -486,7 +782,7 @@ class AsyncEnsembleService:
             finally:
                 with self._lock_cv:
                     self._lock_cv.notify_all()
-        return flight is not None or prev is not None
+        return flight is not None or prev is not None or woke > 0
 
     def _loop(self) -> None:
         while True:
@@ -516,7 +812,8 @@ class AsyncEnsembleService:
                 did = True
             with self._lock_cv:
                 if (self._stop and self._inflight is None
-                        and self.scheduler.pending_count() == 0):
+                        and self.scheduler.pending_count() == 0
+                        and not self._tiering_pending()):
                     return
                 if not did and not self._stop:
                     self._lock_cv.wait(self._poll_interval)

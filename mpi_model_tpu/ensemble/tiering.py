@@ -1,0 +1,548 @@
+"""Scenario tiering: hibernate/wake paging through the delta stream
+(ISSUE 14 tentpole).
+
+"Millions of users" means orders of magnitude more live scenarios than
+fit in device/host memory, and until this PR the serving stack's only
+pressure valve was refusal: a full admission queue raised
+``ServiceOverloaded`` and the scenario was gone. This module gives the
+stack a second tier — scenarios that do not fit the configured
+residency budget HIBERNATE to disk and WAKE when capacity frees — so
+overload degrades to bounded latency instead of sheds.
+
+The format is deliberately nothing new (the one-format discipline):
+
+- **State** pages through the PR 6 delta stream: each hibernated
+  scenario owns a :class:`io.delta.DeltaChain` in the vault directory
+  (``t<ticket>/hib_*``) — the first hibernation writes a keyframe, a
+  re-hibernation of the same (unchanged, still-queued) scenario writes
+  a dirty-tile delta with ZERO dirty tiles, so paging a scenario out
+  again costs metadata, not state bytes. Every piece is CRC32'd; a
+  restore replays keyframe→deltas exactly like a checkpoint restore.
+- **Lifecycle metadata** rides a PR 10 TJ1 ticket journal
+  (``hibernation.journal``): ``hibernate`` (intent — ticket, chain
+  seq, steps, the model's wire recipe) before the chain write,
+  ``hibernated`` (commit — seq, disk bytes) after it, ``wake`` and
+  ``reclaim`` on the way back. The journal reader stops at the first
+  unverifiable byte, so a crash costs exactly the torn suffix.
+
+Crash contract (what :meth:`ScenarioTiering.recover` restores):
+
+- intent + commit, no wake → the scenario is hibernated; it wakes from
+  its chain (restore walks back to the newest record that VERIFIES —
+  for a queued scenario every chain record is the same bytes, so the
+  verified-prefix fallback is bitwise-exact, never stale).
+- intent WITHOUT commit (the in-flight hibernation a crash interrupts)
+  → the chain's newest record may be torn; the wake walks back to the
+  previous committed record, falls back to the caller-supplied journal
+  source (the fleet's submit record), or raises
+  :class:`HibernationError` — NEVER a silent fresh start.
+- wake after the last hibernate → the scenario was resident at the
+  crash; the fleet journal's unresolved-submit replay owns it.
+
+The residency policy is LRU over the RESIDENT set: ``admit`` and
+``touch`` (submit/poll) refresh a ticket's recency, and
+``lru_candidates`` hands the admission path its page-out victims
+oldest-first. The hibernated tier wakes FIFO (arrival order), so no
+scenario starves and wake latency stays bounded by queue position.
+``ServiceOverloaded`` fires only when the hibernation tier itself is
+exhausted (``hibernate_budget``).
+
+Chaos seams (``resilience.inject`` discipline — one global read when
+disarmed): ``hibernate_torn`` tears the chain record a hibernation
+just wrote (silently, like a real torn write), ``wake_corrupt``
+damages the newest record before a restore, ``residency_pressure``
+forces the paging path without real memory pressure.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import shutil
+import time
+import warnings
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.cellular_space import CellularSpace
+from ..io.checkpoint import CheckpointCorruptionError
+from ..io.delta import DeltaChain
+from ..resilience import inject, lockdep
+from ..utils.metrics import ThroughputCounter
+from .journal import TicketJournal, model_from_meta, model_meta, read_records
+
+__all__ = ["HibernationError", "HibernatedScenario", "ScenarioTiering",
+           "scenario_nbytes"]
+
+#: the TJ1 lifecycle journal inside a vault directory
+HIBERNATE_JOURNAL = "hibernation.journal"
+#: chain file prefix inside each per-ticket chain directory
+CHAIN_PREFIX = "hib"
+
+
+class HibernationError(RuntimeError):
+    """A hibernated scenario could not be woken: no chain record
+    verified AND no journal fallback held its state. The ticket
+    resolves with THIS error (a complete, observable outcome) — the
+    tiering layer never hands back fresh or wrong state pretending it
+    is the scenario."""
+
+
+def scenario_nbytes(space: CellularSpace) -> int:
+    """Resident byte cost of one scenario's channel state — what the
+    residency budget meters."""
+    return int(sum(int(v.nbytes) for v in space.values.values()))
+
+
+@dataclasses.dataclass
+class HibernatedScenario:
+    """One paged-out scenario: everything needed to wake it except the
+    state itself (that lives in its chain / the journal)."""
+
+    ticket: int
+    steps: int
+    #: the live model object (exact wake within this process); after a
+    #: crash-restart recovery it is rebuilt from the journaled wire
+    #: recipe (``model_meta``), falling back to the template
+    model: object
+    nbytes: int
+    #: newest chain seq written for this ticket (committed, or the
+    #: in-flight intent a crash interrupted — the wake walks back)
+    seq: int
+    submitted_at: float
+    hibernated_at: float
+    #: structure key for affinity placement on wake (None after
+    #: recovery — recomputed from the restored state)
+    skey: Optional[tuple] = None
+    #: bytes this ticket's chain holds on disk
+    disk_bytes: int = 0
+
+
+class ScenarioTiering:
+    """The hibernate/wake paging engine (module docstring). One
+    instance per serving facade (``AsyncEnsembleService`` /
+    ``FleetSupervisor``); thread-safe behind a single lock —
+    hibernations and wakes serialize against each other (per-stream
+    journal ordering: intent before commit before wake), but never
+    against the caller's admission lock, which this class must not be
+    called under while it does I/O."""
+
+    def __init__(self, directory: str, *, residency_budget: int,
+                 hibernate_budget: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 counter: Optional[ThroughputCounter] = None,
+                 keyframe_every: int = 8):
+        if residency_budget < 1:
+            raise ValueError(
+                f"residency_budget={residency_budget} must be >= 1 byte")
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.residency_budget = int(residency_budget)
+        #: on-disk budget of the hibernation tier (None = unbounded);
+        #: when THIS is exhausted the caller sheds — the only refusal
+        #: left once paging is on
+        self.hibernate_budget = (None if hibernate_budget is None
+                                 else int(hibernate_budget))
+        self.keyframe_every = int(keyframe_every)
+        self._clock = clock
+        self.counter = counter if counter is not None else ThroughputCounter()
+        #: THE tiering lock: tables + the journal/chain write ordering.
+        #: A leaf of the serving stack's acquisition graph (nothing is
+        #: acquired under it).
+        self._lock = lockdep.lock("ScenarioTiering._lock")
+        #: ticket → resident nbytes, in LRU order (oldest first)
+        self._resident: collections.OrderedDict = collections.OrderedDict()
+        self._resident_bytes = 0
+        #: ticket → HibernatedScenario, in FIFO wake order
+        self._hibernated: collections.OrderedDict = collections.OrderedDict()
+        self._hibernated_bytes = 0
+        #: per-ticket chain handles — kept alive across wake so a
+        #: re-hibernation in this process writes a delta, not a keyframe
+        self._chains: dict = {}
+        self._next_seq: dict = {}
+        self.journal = TicketJournal(
+            os.path.join(directory, HIBERNATE_JOURNAL))
+
+    # -- residency accounting (LRU over the resident set) -------------------
+
+    def admit(self, ticket: int, nbytes: int) -> None:
+        """Track one scenario as RESIDENT (submitted or woken)."""
+        with self._lock:
+            if ticket not in self._resident:
+                self._resident_bytes += int(nbytes)
+            self._resident[ticket] = int(nbytes)
+            self._resident.move_to_end(ticket)
+
+    def touch(self, ticket: int) -> None:
+        """LRU refresh: the client showed interest (poll) — a recently
+        polled scenario is a bad page-out victim."""
+        with self._lock:
+            if ticket in self._resident:
+                self._resident.move_to_end(ticket)
+
+    def fits(self, nbytes: int) -> bool:
+        with self._lock:
+            return self._resident_bytes + int(nbytes) \
+                <= self.residency_budget
+
+    def pressure(self, nbytes: int) -> Optional[str]:
+        """Why this admission must PAGE, or None: ``"injected"`` (an
+        armed ``residency_pressure`` fault — the paging path must run
+        even though the budget would fit, so the page-out shortcut is
+        skipped) or ``"budget"`` (the residency budget cannot take the
+        scenario)."""
+        st = inject.active()
+        if st is not None and st.take(
+                "tiering", st.bump("tiering"),
+                kinds=("residency_pressure",)) is not None:
+            return "injected"
+        return None if self.fits(nbytes) else "budget"
+
+    def room_for(self, nbytes: int) -> bool:
+        """Does the hibernation tier have disk budget for ~one more
+        keyframe of this size? (The upper bound — a re-hibernation
+        writes a near-empty delta.)"""
+        if self.hibernate_budget is None:
+            return True
+        with self._lock:
+            return self._hibernated_bytes + int(nbytes) \
+                <= self.hibernate_budget
+
+    def lru_candidates(self) -> list:
+        """Resident tickets in LRU order (oldest-touched first) — the
+        page-out victim preference."""
+        with self._lock:
+            return list(self._resident)
+
+    def release(self, ticket: int) -> None:
+        """The ticket resolved: free its residency and reclaim its
+        chain (if it ever hibernated)."""
+        with self._lock:
+            n = self._resident.pop(ticket, None)
+            if n is not None:
+                self._resident_bytes -= n
+            self._reclaim_locked(ticket)
+
+    def drop(self, ticket: int) -> None:
+        """Resolve a ticket that is still HIBERNATED without waking it
+        (deadline expiry, an unwakeable chain): forget the entry and
+        reclaim the chain. The caller owns publishing the outcome."""
+        with self._lock:
+            e = self._hibernated.pop(ticket, None)
+            if e is not None:
+                self._hibernated_bytes -= e.disk_bytes
+            self._reclaim_locked(ticket)
+
+    def _reclaim_locked(self, ticket: int) -> None:
+        chain = self._chains.pop(ticket, None)
+        self._next_seq.pop(ticket, None)
+        e = self._hibernated.pop(ticket, None)
+        if e is not None:
+            self._hibernated_bytes -= e.disk_bytes
+        d = self._chain_dir(ticket)
+        if chain is None and not os.path.isdir(d):
+            return
+        self._append_locked("reclaim", {"ticket": ticket})
+        if os.path.isdir(d):
+            shutil.rmtree(d, ignore_errors=True)
+
+    # -- the paging primitives ----------------------------------------------
+
+    def _chain_dir(self, ticket: int) -> str:
+        return os.path.join(self.directory, f"t{int(ticket):08d}")
+
+    def _chain_for_locked(self, ticket: int) -> DeltaChain:
+        chain = self._chains.get(ticket)
+        if chain is None:
+            chain = DeltaChain(self._chain_dir(ticket),
+                               prefix=CHAIN_PREFIX,
+                               keyframe_every=self.keyframe_every)
+            self._chains[ticket] = chain
+        return chain
+
+    def _append_locked(self, kind: str, meta: dict) -> None:
+        try:
+            # analysis: ignore[blocking-under-lock] — the tiering
+            # journal's per-ticket record ordering (intent before
+            # commit before wake) is exactly what this lock provides;
+            # same documented trade as the fleet journal's appends
+            self.journal.append(kind, meta)
+        except (OSError, ValueError) as e:
+            self.counter.bump("loop_faults")
+            warnings.warn(
+                f"hibernation journal append ({kind}) failed: {e} — "
+                "paging continues; crash-restart recovery degrades to "
+                "the fleet journal for whatever this record described",
+                RuntimeWarning)
+
+    def hibernate(self, ticket: int, space: CellularSpace, model,
+                  steps: int, *, submitted_at: Optional[float] = None,
+                  skey: Optional[tuple] = None) -> HibernatedScenario:
+        """Page one scenario out: journal the intent (with the model's
+        wire recipe), write the chain record (keyframe first time,
+        near-empty delta on re-hibernation), journal the commit. The
+        in-memory state reference is the caller's to drop — after this
+        returns, the chain + journal ARE the scenario."""
+        nbytes = scenario_nbytes(space)
+        with self._lock:
+            if ticket in self._hibernated:
+                raise ValueError(f"ticket {ticket} is already hibernated")
+            seq = self._next_seq.get(ticket, 0)
+            rehib = seq > 0
+            self._append_locked("hibernate", {
+                "ticket": int(ticket), "seq": seq, "steps": int(steps),
+                "nbytes": nbytes, "model": model_meta(model)})
+            chain = self._chain_for_locked(ticket)
+            # analysis: ignore[blocking-under-lock] — the chain write
+            # must land between this ticket's intent and commit journal
+            # records (the crash contract recover() replays); paging
+            # I/O serializes against other paging I/O only — the
+            # caller's admission lock is never held here
+            path = chain.save(space, seq)
+            inject.hibernate_torn(path, seq)
+            self._next_seq[ticket] = seq + 1
+            disk = self._dir_bytes(ticket)
+            self._append_locked("hibernated", {
+                "ticket": int(ticket), "seq": seq, "disk_bytes": disk})
+            now = self._clock()
+            entry = HibernatedScenario(
+                ticket=int(ticket), steps=int(steps), model=model,
+                nbytes=nbytes, seq=seq,
+                submitted_at=(now if submitted_at is None
+                              else float(submitted_at)),
+                hibernated_at=now, skey=skey, disk_bytes=disk)
+            self._hibernated[ticket] = entry
+            self._hibernated_bytes += disk
+            # a hibernated ticket is no longer resident
+            n = self._resident.pop(ticket, None)
+            if n is not None:
+                self._resident_bytes -= n
+        self.counter.bump("hibernations")
+        if rehib:
+            self.counter.bump("rehibernations")
+        return entry
+
+    def is_hibernated(self, ticket: int) -> bool:
+        with self._lock:
+            return ticket in self._hibernated
+
+    def hibernated_count(self) -> int:
+        with self._lock:
+            return len(self._hibernated)
+
+    def peek_next(self) -> Optional[tuple]:
+        """(ticket, nbytes) of the next FIFO wake candidate, or None."""
+        with self._lock:
+            for t, e in self._hibernated.items():
+                return t, e.nbytes
+            return None
+
+    def entry(self, ticket: int) -> Optional[HibernatedScenario]:
+        with self._lock:
+            return self._hibernated.get(ticket)
+
+    def wake(self, ticket: int,
+             fallback: Optional[Callable] = None
+             ) -> tuple[CellularSpace, HibernatedScenario]:
+        """Materialize one hibernated scenario: restore the newest
+        chain record that VERIFIES (walking back through the chain — a
+        torn/corrupt newest record costs nothing for a queued scenario,
+        every record is the same bytes), else ``fallback(ticket)`` (the
+        fleet journal's submit-record source), else raise
+        :class:`HibernationError`. On success the entry leaves the
+        hibernated tier (the chain stays on disk until the ticket
+        resolves — it is the re-hibernation base and the crash source);
+        on failure it stays for the caller to ``drop`` after publishing
+        the error. Wall seconds of the materialization feed the
+        wake-latency reservoir."""
+        t0 = time.perf_counter()
+        with self._lock:
+            e = self._hibernated.get(ticket)
+            if e is None:
+                raise KeyError(f"ticket {ticket} is not hibernated")
+            fault = inject.wake_corrupt(ticket)
+            chain = self._chain_for_locked(ticket)
+            if fault is not None:
+                self._corrupt_newest_locked(ticket, chain, fault)
+            space = None
+            source = None
+            last_err: Optional[Exception] = None
+            # analysis: ignore[blocking-under-lock] — the wake's chain
+            # walk (manifest read + restore) IS the paging tier's I/O;
+            # it serializes only against other paging operations — the
+            # caller's admission lock is never held across wake
+            for s in sorted(chain.steps(), reverse=True):
+                try:
+                    # analysis: ignore[blocking-under-lock] — the wake
+                    # restore is the paging tier's I/O; it serializes
+                    # only against other paging I/O (the caller's
+                    # admission lock is never held across wake)
+                    ck = chain.restore(s)
+                except (CheckpointCorruptionError, FileNotFoundError) as ex:
+                    last_err = ex
+                    continue
+                space, source = ck.space, f"chain:{s}"
+                break
+            if space is None and last_err is not None:
+                warnings.warn(
+                    f"wake of ticket {ticket}: no chain record verified "
+                    f"({last_err}); falling back to the journal source",
+                    RuntimeWarning)
+            if space is None and fallback is not None:
+                space = fallback(ticket)
+                source = "journal"
+            if space is None:
+                self.counter.bump("wake_faults")
+                raise HibernationError(
+                    f"ticket {ticket} cannot wake: no chain record "
+                    f"verified ({last_err}) and no journal source holds "
+                    "its state — resolving loudly instead of resuming "
+                    "fresh or wrong state")
+            if source == "journal":
+                self.counter.bump("wake_faults")
+            self._append_locked("wake", {
+                "ticket": int(ticket), "seq": e.seq, "source": source})
+            self._hibernated.pop(ticket)
+            self._hibernated_bytes -= e.disk_bytes
+        self.counter.bump("wakes")
+        self.counter.record_wake_latency(time.perf_counter() - t0)
+        return space, e
+
+    def requeue(self, ticket: int, entry: HibernatedScenario) -> None:
+        """A woken scenario found no placement (every member refused
+        mid-wake): put it back at the HEAD of the wake queue without
+        rewriting its chain (the state on disk is unchanged). The
+        journal records the round trip so recovery still sees it
+        hibernated."""
+        with self._lock:
+            self._append_locked("requeue", {
+                "ticket": int(ticket), "seq": entry.seq})
+            self._hibernated[ticket] = entry
+            self._hibernated.move_to_end(ticket, last=False)
+            self._hibernated_bytes += entry.disk_bytes
+
+    def _corrupt_newest_locked(self, ticket: int, chain: DeltaChain,
+                               fault) -> None:
+        # analysis: ignore[blocking-under-lock] — chaos-only path (an
+        # armed wake_corrupt fault): damages the chain under the same
+        # vault lock the wake it targets holds, by design
+        steps = chain.steps()
+        if not steps:
+            return
+        for kind in ("delta", "keyframe"):
+            p = chain.record_path(max(steps), kind)
+            if os.path.exists(p):
+                inject.tear_file(p, fault.offset, fault.nbytes,
+                                 fault.tear)
+                return
+
+    def _dir_bytes(self, ticket: int) -> int:
+        d = self._chain_dir(ticket)
+        total = 0
+        for fn in os.listdir(d):
+            try:
+                total += os.path.getsize(os.path.join(d, fn))
+            except OSError:  # pragma: no cover - racing reclaim
+                continue
+        return total
+
+    # -- crash-restart recovery ----------------------------------------------
+
+    def recover(self, template_model=None) -> dict:
+        """Fold the vault journal's verified prefix to the set of
+        tickets that were HIBERNATED at the crash (module docstring has
+        the contract) and re-enter them in the in-memory tier, FIFO
+        order preserved. Models rebuild from their journaled wire
+        recipes (``template_model`` when a recipe was absent). Returns
+        ticket → entry; in-flight hibernations (intent, no commit) are
+        included — their wake walks the chain back or falls through to
+        the caller's journal source."""
+        records, torn = read_records(self.journal.path)
+        if torn:
+            warnings.warn(
+                f"hibernation journal {self.journal.path} had a torn "
+                "tail — recovered the verified prefix",
+                RuntimeWarning)
+        state: dict = {}
+        for rec in records:
+            t = rec.meta.get("ticket")
+            if t is None:
+                continue
+            if rec.kind == "hibernate":
+                state[t] = {"meta": rec.meta, "seq": rec.meta["seq"],
+                            "committed": False, "hibernated": True,
+                            "order": rec.index}
+            elif rec.kind == "hibernated" and t in state:
+                state[t]["committed"] = True
+                state[t]["disk"] = rec.meta.get("disk_bytes", 0)
+            elif rec.kind == "requeue" and t in state:
+                state[t]["hibernated"] = True
+            elif rec.kind == "wake" and t in state:
+                state[t]["hibernated"] = False
+            elif rec.kind == "reclaim":
+                state.pop(t, None)
+        out: dict = {}
+        now = self._clock()
+        with self._lock:
+            for t, st in sorted(state.items(),
+                                key=lambda kv: kv[1]["order"]):
+                if not st["hibernated"]:
+                    continue
+                meta = st["meta"]
+                model = model_from_meta(meta.get("model"), template_model)
+                if model is None:
+                    warnings.warn(
+                        f"hibernated ticket {t} has no model recipe and "
+                        "no template — it cannot be recovered here",
+                        RuntimeWarning)
+                    continue
+                disk = (st.get("disk") if st["committed"]
+                        else None)
+                if disk is None:
+                    disk = (self._dir_bytes(t)
+                            if os.path.isdir(self._chain_dir(t)) else 0)
+                e = HibernatedScenario(
+                    ticket=int(t), steps=int(meta.get("steps", 0)),
+                    model=model, nbytes=int(meta.get("nbytes", 0)),
+                    seq=int(st["seq"]), submitted_at=now,
+                    hibernated_at=now, skey=None, disk_bytes=int(disk))
+                self._hibernated[t] = e
+                self._hibernated_bytes += e.disk_bytes
+                self._next_seq[t] = e.seq + 1
+                out[t] = e
+            # orphan sweep: a ticket whose LAST lifecycle record was a
+            # wake was resident at the crash — the fleet journal owns
+            # its recovery, but its chain directory would otherwise
+            # leak on disk forever (and never count against the
+            # hibernate budget). Reclaim every vault dir without a
+            # recovered entry; a later re-hibernation of the same
+            # ticket starts a fresh chain at seq 0.
+            for fn in os.listdir(self.directory):
+                if not (fn.startswith("t") and fn[1:].isdigit()):
+                    continue
+                t = int(fn[1:])
+                if t in self._hibernated:
+                    continue
+                self._append_locked("reclaim", {"ticket": t})
+                shutil.rmtree(os.path.join(self.directory, fn),
+                              ignore_errors=True)
+                self._next_seq.pop(t, None)
+        return out
+
+    # -- observability --------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "resident_scenarios": len(self._resident),
+                "resident_bytes": self._resident_bytes,
+                "residency_budget": self.residency_budget,
+                "hibernated_scenarios": len(self._hibernated),
+                "hibernated_bytes": self._hibernated_bytes,
+                "hibernate_budget": self.hibernate_budget,
+            }
+
+    def close(self) -> None:
+        self.journal.close()

@@ -77,6 +77,20 @@ site           where the seam lives / what the fault does
                bytes so the peer's CRC fires; ``tear="truncate"`` sends
                a prefix and closes — the crash-mid-write shape), and
                the codec must raise its typed error, never hang
+``tiering``    the scenario hibernate/wake paging layer (ISSUE 14) —
+               ``kind="hibernate_torn"`` tears/corrupts the chain
+               record a hibernation just wrote (``at`` pins the
+               chain seq; the tear is SILENT, like a real torn write —
+               the wake path's verified-prefix fallback is what the
+               matrix asserts); ``kind="wake_corrupt"`` damages the
+               newest chain record right before a wake's restore
+               (``ticket`` pins the target), driving the
+               prefix-fallback → journal-re-admit → loud
+               ``HibernationError`` ladder (never a silent fresh
+               start); ``kind="residency_pressure"`` makes one
+               admission behave as if the residency budget were
+               exhausted — the paging path (hibernate instead of
+               shed) without needing real memory pressure
 =============  ==============================================================
 
 Zero overhead when disarmed: every seam starts with one module-global
@@ -111,6 +125,8 @@ __all__ = [
     "poison_values",
     "checkpoint_torn",
     "journal_torn",
+    "hibernate_torn",
+    "wake_corrupt",
     "tear_file",
 ]
 
@@ -153,6 +169,10 @@ SITE_OF = {
     "proc_kill": "wire",
     "heartbeat_loss": "wire",
     "wire_torn": "wire",
+    # ISSUE 14: the scenario-tiering (hibernate/wake paging) seams
+    "hibernate_torn": "tiering",
+    "wake_corrupt": "tiering",
+    "residency_pressure": "tiering",
 }
 
 
@@ -168,7 +188,8 @@ class Fault:
 
     kind: str
     #: seam firing index (None = first opportunity); for "torn" this is
-    #: the checkpoint step being written; for the member faults
+    #: the checkpoint step being written, for "hibernate_torn" the
+    #: chain seq being written; for the member faults
     #: ("member_kill"/"member_wedge") it is a THRESHOLD, not an index:
     #: the fault is eligible only once the pump site has been visited
     #: at least ``at`` times fleet-wide — how a chaos plan lands a kill
@@ -184,7 +205,9 @@ class Fault:
     #: scenario lane to poison (direct run_ensemble use; also the
     #: "fetch_nan" target lane, default 0)
     lane: Optional[int] = None
-    #: scheduler ticket whose lane to poison (the scheduler maps it)
+    #: scheduler ticket whose lane to poison (the scheduler maps it);
+    #: "wake_corrupt" reuses this as the hibernated ticket to target
+    #: (None = any wake)
     ticket: Optional[int] = None
     #: byte offset for "torn"
     offset: int = 0
@@ -510,6 +533,48 @@ def journal_torn(path: str, index: int, record_start: int) -> None:
         st._fire(i, f)
         tear_file(path, record_start + f.offset, f.nbytes, f.tear)
         return
+
+
+def hibernate_torn(path: str, seq: int) -> None:
+    """Scenario-tiering seam (ISSUE 14): tear/corrupt the chain record
+    a hibernation just wrote. ``at`` pins the chain seq being written
+    (None = first opportunity). The tear is SILENT — hibernate goes on
+    to commit its journal record, exactly like a write torn by a real
+    crash or bit rot after the fact — so the wake path's
+    verified-prefix fallback (an earlier chain record, bitwise-equal
+    for a queued scenario) is what recovers it."""
+    st = _ACTIVE
+    if st is None:
+        return
+    for i, f in enumerate(st.plan.faults):
+        if f.kind != "hibernate_torn" or i in st._consumed:
+            continue
+        if f.at is not None and f.at != seq:
+            continue
+        st._fire(i, f)
+        tear_file(path, f.offset, f.nbytes, f.tear)
+        return
+
+
+def wake_corrupt(ticket) -> Optional["Fault"]:
+    """Scenario-tiering seam (ISSUE 14): a live ``wake_corrupt`` fault
+    aimed at ``ticket`` (``ticket=None`` matches any wake), consumed
+    per ``once``. The tiering layer applies the fault's tear to the
+    ticket's NEWEST chain record before restoring, so the wake must
+    walk back to the verified prefix, re-admit from the journal, or
+    fail loudly — never resume wrong or fresh state."""
+    st = _ACTIVE
+    if st is None:
+        return None
+    with st._mutex:
+        for i, f in enumerate(st.plan.faults):
+            if f.kind != "wake_corrupt" or i in st._consumed:
+                continue
+            if f.ticket is not None and f.ticket != ticket:
+                continue
+            st._fire_locked(i, f)
+            return f
+        return None
 
 
 def tear_file(path: str, offset: int = 0, nbytes: int = 64,

@@ -82,7 +82,9 @@ class FailureEvent:
     #: queued ticket's per-ticket deadline passed before dispatch —
     #: the ISSUE 9 serving path; never a silent drop) | "member" (a
     #: fleet member was fenced — dead pump, supervision-deadline wedge
-    #: or ladder bottom — and restarted fresh, ISSUE 10)
+    #: or ladder bottom — and restarted fresh, ISSUE 10) |
+    #: "hibernation" (a hibernated scenario could not be woken from
+    #: any source — chain, journal — and resolved loudly, ISSUE 14)
     kind: str
     detail: str
     #: step rolled back to (== step of the last good checkpoint)

@@ -248,7 +248,8 @@ class ThroughputCounter:
                 "impl_faults", "shed", "expired", "loop_faults",
                 "member_faults", "readmitted", "scale_ups", "scale_downs",
                 "respawns", "heartbeats", "heartbeat_misses",
-                "wire_errors")
+                "wire_errors", "hibernations", "rehibernations",
+                "wakes", "wake_faults")
 
     def __init__(self):
         # lockdep factory (ISSUE 12): plain Lock disarmed, witnessed
@@ -294,7 +295,22 @@ class ThroughputCounter:
         self.heartbeats = 0
         self.heartbeat_misses = 0
         self.wire_errors = 0
+        #: ISSUE 14 (scenario tiering): scenarios paged to the
+        #: hibernation tier (rehibernations = the subset that had
+        #: already hibernated once — their chain writes are deltas),
+        #: scenarios woken back to residency, and wakes that could not
+        #: restore their chain (fell back to the journal or resolved
+        #: as a HibernationError — never a silent fresh start)
+        self.hibernations = 0
+        self.rehibernations = 0
+        self.wakes = 0
+        self.wake_faults = 0
         self._latencies: collections.deque = collections.deque(
+            maxlen=LATENCY_RESERVOIR)
+        #: wall seconds each wake spent materializing its scenario
+        #: (chain restore + resubmit) — the paging cost a client
+        #: actually observes; bounded like the queue-latency reservoir
+        self._wake_latencies: collections.deque = collections.deque(
             maxlen=LATENCY_RESERVOIR)
 
     def record_dispatch(self, scenarios: int, bucket: int, wall_s: float,
@@ -334,6 +350,14 @@ class ThroughputCounter:
         with self._lock:
             self._latencies.append(float(seconds))
 
+    def record_wake_latency(self, seconds: float) -> None:
+        """One wake's wall seconds (hibernation-chain restore through
+        resubmission — ``time.perf_counter`` spans, real even under a
+        fake scheduler clock), feeding the ``wake_latency_p50_s``/
+        ``wake_latency_p99_s`` snapshot fields."""
+        with self._lock:
+            self._wake_latencies.append(float(seconds))
+
     @staticmethod
     def _percentile(sorted_samples: list, q: float) -> float:
         i = min(int(round(q * (len(sorted_samples) - 1))),
@@ -343,6 +367,7 @@ class ThroughputCounter:
     def snapshot(self) -> dict:
         with self._lock:
             lat = sorted(self._latencies)
+            wlat = sorted(self._wake_latencies)
             return {
                 "dispatches": self.dispatches,
                 "scenarios": self.scenarios,
@@ -370,11 +395,20 @@ class ThroughputCounter:
                 "heartbeats": self.heartbeats,
                 "heartbeat_misses": self.heartbeat_misses,
                 "wire_errors": self.wire_errors,
+                "hibernations": self.hibernations,
+                "rehibernations": self.rehibernations,
+                "wakes": self.wakes,
+                "wake_faults": self.wake_faults,
                 "latency_n": len(lat),
                 "latency_p50_s": (self._percentile(lat, 0.50)
                                   if lat else None),
                 "latency_p99_s": (self._percentile(lat, 0.99)
                                   if lat else None),
+                "wake_latency_n": len(wlat),
+                "wake_latency_p50_s": (self._percentile(wlat, 0.50)
+                                       if wlat else None),
+                "wake_latency_p99_s": (self._percentile(wlat, 0.99)
+                                       if wlat else None),
             }
 
 

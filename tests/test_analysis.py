@@ -573,6 +573,41 @@ def test_naked_save_delta_module_is_a_boundary():
     assert rules_of(lint_source(src3, PKG)) == []
 
 
+def test_naked_save_covers_hibernation_writes(tmp_path):
+    """ISSUE 14 satellite: hibernation writes are only legal through
+    the io/delta.py / ensemble/tiering.py boundary — a module writing
+    its own 'vault'/'tiering' chain records bypasses the intent→
+    commit journal ordering the crash contract depends on."""
+    # vault/tiering-ish receivers ride the managerish .save rule
+    src = ("class S:\n"
+           "    def f(self, space):\n"
+           "        self.vault.save(space, 3)\n"
+           "        self.tiering_chain.save(space, 3)\n")
+    assert rules_of(lint_source(src, PKG)) == ["naked-save",
+                                               "naked-save"]
+    # the raw chain-record writer stays flagged wherever it appears
+    src2 = ("from mpi_model_tpu.io.delta import write_chain_record\n"
+            "def hib(meta, payload):\n"
+            "    write_chain_record('vault/t0/hib_1.kf.npz', meta, "
+            "payload)\n")
+    assert rules_of(lint_source(src2, PKG)) == ["naked-save"]
+
+
+def test_naked_save_tiering_module_is_a_boundary():
+    """ensemble/tiering.py IS the sanctioned hibernation boundary —
+    its chain.save drive is the one legal site (like io/delta.py)."""
+    src = ("def hibernate(chain, space, seq):\n"
+           "    chain.save(space, seq)\n")
+    assert rules_of(lint_source(
+        src, "mpi_model_tpu/ensemble/tiering.py")) == []
+    # calling the tiering FACADE (hibernate/wake) is not a raw write —
+    # the serving layers drive the boundary legally
+    src2 = ("class Svc:\n"
+            "    def admit(self, space, model):\n"
+            "        self.tiering.hibernate(0, space, model, 4)\n")
+    assert rules_of(lint_source(src2, PKG)) == []
+
+
 def test_naked_save_pragma_suppresses_with_reason():
     src = ("def f(mgr, space):\n"
            "    # analysis: ignore[naked-save] — bootstrap write before\n"

@@ -769,3 +769,158 @@ def test_abandoned_member_loop_exits_without_draining():
     assert svc.poll(t) is None
     with pytest.raises(RuntimeError, match="abandoned"):
         svc.start()
+
+
+# -- spawn outside the fleet lock (ISSUE 14 satellite / PR 13 remainder) ------
+
+def test_admissions_proceed_during_a_slow_respawn():
+    """A member respawn used to run UNDER the fleet lock: a process
+    member's ~2 s spawn+connect stalled every submit/poll for the
+    duration. Now the tick fences under the lock, spawns outside it,
+    and installs + drains in a second locked phase — so an admission
+    issued WHILE the replacement spawner is blocked must complete on
+    the surviving member instead of waiting for the spawn."""
+    import threading
+
+    from mpi_model_tpu.ensemble.member_proc import spawn_loopback_member
+
+    spawn_blocked = threading.Event()
+    release_spawn = threading.Event()
+
+    def gated_spawner(model, *, service_id, **kw):
+        if service_id.endswith("g1"):    # the respawn, not the boot
+            spawn_blocked.set()
+            assert release_spawn.wait(timeout=30)
+        return spawn_loopback_member(model, service_id=service_id, **kw)
+
+    model = scen_model()
+    fleet = FleetSupervisor(model, services=2, steps=2, start=True,
+                            member_transport="process",
+                            member_spawner=gated_spawner,
+                            heartbeat_deadline_s=0.2,
+                            tick_interval_s=0.01)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            # hard-stop one member's serve thread: its wire dies, the
+            # supervision thread fences it and blocks in the gated
+            # spawner — OUTSIDE the fleet lock
+            victim = fleet._members[0].service
+            victim.kill()
+            assert spawn_blocked.wait(timeout=30), \
+                "the respawn never started"
+            # the regression: this submit must be served by the
+            # surviving member WHILE the respawn is still blocked
+            t = fleet.submit(scen_space(0))
+            out = fleet.result(t, timeout=30)
+            assert out is not None
+            assert spawn_blocked.is_set() and not release_spawn.is_set()
+    finally:
+        release_spawn.set()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            fleet.stop()
+    st = fleet.stats()
+    assert st["respawns"] >= 1
+    assert st["member_faults"] >= 1
+
+
+def test_failed_respawn_is_retried_next_tick():
+    """A transiently-failing spawner must not permanently shrink the
+    fleet: the failed spawn request is RE-QUEUED and the next tick
+    restores the slot (review finding on the spawn-outside-the-lock
+    restructure)."""
+    from mpi_model_tpu.ensemble.member_proc import spawn_loopback_member
+    from mpi_model_tpu.resilience.inject import Fault, FaultPlan
+
+    flaky = {"fails_left": 1}
+
+    def flaky_spawner(model, *, service_id, **kw):
+        if service_id.endswith("g1") and flaky["fails_left"] > 0:
+            flaky["fails_left"] -= 1
+            raise RuntimeError("transient spawner failure")
+        return spawn_loopback_member(model, service_id=service_id, **kw)
+
+    clock = {"t": 0.0}
+    fleet = FleetSupervisor(scen_model(), services=2, steps=2,
+                            start=False, member_transport="process",
+                            member_spawner=flaky_spawner,
+                            heartbeat_deadline_s=1.0,
+                            clock=lambda: clock["t"])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        fleet._members[0].service.kill()
+        clock["t"] = 2.0
+        fleet.tick()                   # fence; replacement spawn FAILS
+        assert fleet.stats()["members"] == 1
+        assert fleet.counter.loop_faults >= 1
+        fleet.tick()                   # the re-queued spawn succeeds
+    st = fleet.stats()
+    assert st["members"] == 2          # capacity restored
+    assert flaky["fails_left"] == 0
+    t = fleet.submit(scen_space(0))
+    assert fleet.result(t) is not None
+    fleet.stop()
+
+
+def test_fleet_hibernation_write_failure_sheds_observably(tmp_path):
+    """An unwritable vault must not create a forever-pending ghost
+    ticket: the admission sheds with ServiceOverloaded, the journaled
+    submit gets its terminal record, and the replay audit stays
+    complete (review finding on the paged admission)."""
+    from mpi_model_tpu.ensemble import scenario_nbytes
+    from mpi_model_tpu.ensemble.journal import journal_path, replay
+
+    jd = str(tmp_path / "j")
+    fleet = FleetSupervisor(scen_model(), services=1, steps=2,
+                            start=False, max_queue=1, journal_dir=jd,
+                            residency_budget=1,
+                            hibernate_dir=str(tmp_path / "v"))
+
+    def broken_hibernate(*a, **kw):
+        raise OSError("vault full")
+
+    fleet.tiering.hibernate = broken_hibernate
+    with pytest.raises(ServiceOverloaded,
+                       match="hibernation write failed"):
+        fleet.submit(scen_space(0))
+    st = fleet.stats()
+    assert st["shed"] == 1 and st["pending"] == 0
+    fleet.stop()
+    audit = replay(journal_path(jd))
+    assert audit.unresolved() == [] and not audit.duplicate_terminals
+
+
+def test_sole_member_fence_defers_drain_until_respawn_lands():
+    """services=1 + a transiently failing spawner: the fenced member's
+    drain is DEFERRED until the retried spawn installs, so its tickets
+    re-admit to the replacement instead of resolving as MemberFailure
+    for want of a one-tick-late candidate (review finding)."""
+    from mpi_model_tpu.ensemble.member_proc import spawn_loopback_member
+
+    flaky = {"fails_left": 1}
+
+    def flaky_spawner(model, *, service_id, **kw):
+        if service_id.endswith("g1") and flaky["fails_left"] > 0:
+            flaky["fails_left"] -= 1
+            raise RuntimeError("transient spawner failure")
+        return spawn_loopback_member(model, service_id=service_id, **kw)
+
+    clock = {"t": 0.0}
+    fleet = FleetSupervisor(scen_model(), services=1, steps=2,
+                            start=False, member_transport="process",
+                            member_spawner=flaky_spawner,
+                            heartbeat_deadline_s=1.0, max_wait_s=1e9,
+                            max_batch=8, clock=lambda: clock["t"])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        t = fleet.submit(scen_space(0))
+        fleet._members[0].service.kill()
+        clock["t"] = 2.0
+        fleet.tick()          # fence; spawn FAILS; drain DEFERRED
+        assert fleet.poll(t) is None      # the ticket survived
+        fleet.tick()          # retried spawn lands; drain re-admits
+        assert fleet.counter.readmitted >= 1
+        out = fleet.result(t)
+    assert out is not None
+    fleet.stop()

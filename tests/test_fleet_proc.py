@@ -594,3 +594,78 @@ def test_real_process_results_bitwise_equal_inproc():
         np.testing.assert_array_equal(
             np.asarray(got[i].values["value"]),
             np.asarray(want[i].values["value"]))
+
+
+# -- scenario tiering across the wire (ISSUE 14) ------------------------------
+
+def test_tiering_pages_and_wakes_across_the_wire_bitwise():
+    """The paging tier with PROCESS members (loopback): admissions
+    beyond the residency budget hibernate at the FLEET level, wake
+    FIFO, and their placements cross the wire like any submission —
+    every served state bitwise-equal to the synchronous scheduler,
+    zero sheds, wakes attributed per member."""
+    from mpi_model_tpu.ensemble import scenario_nbytes
+
+    import tempfile
+
+    model = scen_model()
+    spaces = [scen_space(i) for i in range(6)]
+    models = [scen_model(i) for i in range(6)]
+    sync = EnsembleService(model, steps=4)
+    ts = [sync.submit(spaces[i], model=models[i]) for i in range(6)]
+    sync.flush()
+    want = [np.asarray(sync.result(t)[0].values["value"]) for t in ts]
+
+    one = scenario_nbytes(spaces[0])
+    fleet = proc_fleet(model, services=2,
+                       residency_budget=2 * one + 1,
+                       hibernate_dir=tempfile.mkdtemp(prefix="wire-tier-"))
+    tp = [fleet.submit(spaces[i], model=models[i]) for i in range(6)]
+    st = fleet.stats()
+    assert st["hibernated_scenarios"] == 4 and st["shed"] == 0
+    for i, t in enumerate(tp):
+        out, _rep = fleet.result(t)
+        np.testing.assert_array_equal(
+            np.asarray(out.values["value"]), want[i])
+    st = fleet.stats()
+    assert st["wakes"] == 4 and st["shed"] == 0
+    assert sum(st["wakes_by_member"].values()) == 4
+    fleet.stop()
+
+
+def test_tiering_wake_survives_proc_kill_fence():
+    """A hibernated ticket belongs to no member: the loopback
+    ``proc_kill`` fencing one member while scenarios sleep changes
+    nothing — wakes land on the survivor/replacement and everything
+    serves with zero sheds."""
+    from mpi_model_tpu.ensemble import scenario_nbytes
+
+    import tempfile
+
+    clock = {"t": 0.0}
+    model = scen_model()
+    one = scenario_nbytes(scen_space(0))
+    fleet = proc_fleet(model, services=2, clock=lambda: clock["t"],
+                       heartbeat_deadline_s=1.0,
+                       residency_budget=one + 1,
+                       hibernate_dir=tempfile.mkdtemp(prefix="wire-tk-"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        tickets = [fleet.submit(scen_space(i)) for i in range(4)]
+        assert fleet.stats()["hibernated_scenarios"] >= 2
+        fleet.tick()   # heartbeat: refresh the cached telemetry cut
+        victim = next(s["service_id"]
+                      for s in fleet.stats()["services"]
+                      if s["pending"] > 0)
+        with inject.armed(FaultPlan(
+                (Fault("proc_kill", channel=victim),))):
+            fleet.pump_once()   # the kill lands on a wire RPC
+            clock["t"] = 2.0    # age past the heartbeat deadline
+            fleet.pump_once()
+            outs = [fleet.result(t) for t in tickets]
+    stats = fleet.stats()
+    fleet.stop()
+    assert len(outs) == 4
+    assert stats["respawns"] >= 1
+    assert stats["shed"] == 0
+    assert stats["wakes"] >= 2

@@ -1,0 +1,611 @@
+"""Scenario-tiering tests (ISSUE 14 tentpole): hibernate/wake paging
+through the delta stream. Unit rows drive ``ScenarioTiering`` directly
+(chain round-trips bitwise, re-hibernation writes a near-empty delta,
+the verified-prefix → journal → loud-error wake ladder, crash-restart
+recovery of in-flight hibernations from the TJ1 journal); service rows
+drive the ``AsyncEnsembleService`` paging overlay (LRU page-out,
+hibernation instead of shedding, deadline expiry while hibernated,
+tier-exhausted sheds); fleet rows drive the ``FleetSupervisor`` tier
+(hibernate when every member refuses, structure-affine wake placement
+with per-member attribution, wakes surviving member fencing, recover()
+re-entering hibernated tickets from their chains) — capped by the
+ACCEPTANCE soak: a working set 10× the residency budget completing
+with zero sheds, bounded measured wake latency, every woken scenario
+bitwise-equal to its never-hibernated twin, and the kill-mid-soak leg
+recovering exactly-once, all lockdep-armed against the static
+acquisition graph. Every latency path runs on the injectable clock —
+zero wall sleeps."""
+
+import warnings
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mpi_model_tpu import CellularSpace, Diffusion, Model
+from mpi_model_tpu.ensemble import (
+    AsyncEnsembleService,
+    EnsembleService,
+    FleetSupervisor,
+    HibernationError,
+    ScenarioTiering,
+    ServiceOverloaded,
+    TicketExpired,
+    scenario_nbytes,
+)
+from mpi_model_tpu.ensemble.journal import (journal_path, read_records,
+                                            replay)
+from mpi_model_tpu.ensemble.tiering import HIBERNATE_JOURNAL
+from mpi_model_tpu.resilience import inject
+from mpi_model_tpu.resilience.inject import Fault, FaultPlan
+
+
+def scen_space(i, g=16, dtype=jnp.float64):
+    rng = np.random.default_rng((53, i, g))
+    v = jnp.asarray(rng.uniform(0.5, 2.0, (g, g)), dtype)
+    return CellularSpace.create(g, g, 1.0, dtype=dtype).with_values(
+        {"value": v})
+
+
+def scen_model(i=0):
+    return Model(Diffusion(0.05 + 0.01 * i), 4.0, 1.0)
+
+
+def sync_twin(spaces, models, steps=4):
+    """Never-hibernated reference states, served synchronously."""
+    svc = EnsembleService(models[0], steps=steps)
+    ts = [svc.submit(s, model=m) for s, m in zip(spaces, models)]
+    svc.flush()
+    return [np.asarray(svc.result(t)[0].values["value"]) for t in ts]
+
+
+def one_nbytes(g=16):
+    return scenario_nbytes(scen_space(0, g))
+
+
+# -- unit: the vault ----------------------------------------------------------
+
+def test_hibernate_wake_roundtrip_bitwise(tmp_path):
+    """The paging primitive: state out through the delta chain, back
+    in CRC-verified, bitwise; lifecycle journaled in TJ1 order."""
+    vault = ScenarioTiering(str(tmp_path), residency_budget=1)
+    sp = scen_space(0)
+    vault.hibernate(7, sp, scen_model(), 4)
+    assert vault.is_hibernated(7)
+    assert vault.stats()["hibernated_scenarios"] == 1
+    assert vault.stats()["hibernated_bytes"] > 0
+    out, entry = vault.wake(7)
+    np.testing.assert_array_equal(np.asarray(out.values["value"]),
+                                  np.asarray(sp.values["value"]))
+    assert entry.steps == 4 and not vault.is_hibernated(7)
+    records, torn = read_records(str(tmp_path / HIBERNATE_JOURNAL))
+    assert not torn
+    assert [r.kind for r in records] == ["hibernate", "hibernated",
+                                         "wake"]
+    assert vault.counter.hibernations == 1 and vault.counter.wakes == 1
+    vault.release(7)  # reclaim: the chain dir goes away
+    assert vault.stats()["hibernated_bytes"] == 0
+
+
+def test_rehibernation_writes_near_empty_delta(tmp_path):
+    """Paging through the delta stream: the SECOND hibernation of an
+    unchanged scenario is a dirty-tile delta with zero dirty tiles —
+    metadata, not state bytes."""
+    vault = ScenarioTiering(str(tmp_path), residency_budget=1)
+    sp = scen_space(1)
+    vault.hibernate(3, sp, scen_model(), 4)
+    kf_bytes = vault.stats()["hibernated_bytes"]
+    out, _ = vault.wake(3)
+    vault.hibernate(3, out, scen_model(), 4)
+    delta_bytes = vault.stats()["hibernated_bytes"] - kf_bytes
+    assert 0 < delta_bytes < kf_bytes / 2, (kf_bytes, delta_bytes)
+    assert vault.counter.rehibernations == 1
+    out2, _ = vault.wake(3)
+    np.testing.assert_array_equal(np.asarray(out2.values["value"]),
+                                  np.asarray(sp.values["value"]))
+
+
+def test_lru_order_follows_touch(tmp_path):
+    vault = ScenarioTiering(str(tmp_path), residency_budget=100)
+    for t in (1, 2, 3):
+        vault.admit(t, 10)
+    vault.touch(1)
+    assert vault.lru_candidates() == [2, 3, 1]
+    vault.release(2)
+    assert vault.lru_candidates() == [3, 1]
+    assert vault.stats()["resident_bytes"] == 20
+    assert not vault.fits(81) and vault.fits(80)
+
+
+def test_hibernate_torn_wakes_from_verified_prefix(tmp_path):
+    """The ``hibernate_torn`` chaos row: a torn re-hibernation record
+    is silent at write time; the wake walks back to the previous
+    verified chain record — bitwise-equal for a queued scenario."""
+    vault = ScenarioTiering(str(tmp_path), residency_budget=1)
+    sp = scen_space(2)
+    vault.hibernate(5, sp, scen_model(), 4)
+    out, _ = vault.wake(5)
+    with inject.armed(FaultPlan((Fault("hibernate_torn", at=1,
+                                       nbytes=256),))) as st:
+        vault.hibernate(5, out, scen_model(), 4)  # the delta tears
+    assert [f["kind"] for f in st.fired] == ["hibernate_torn"]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        out2, _ = vault.wake(5)
+    np.testing.assert_array_equal(np.asarray(out2.values["value"]),
+                                  np.asarray(sp.values["value"]))
+    # the prefix recovery is a CHAIN recovery, not a journal fallback
+    assert vault.counter.wake_faults == 0 and vault.counter.wakes == 2
+
+
+def test_wake_corrupt_falls_back_to_journal_source(tmp_path):
+    """The ``wake_corrupt`` chaos row, middle rung: every chain record
+    damaged → the wake re-admits from the caller's journal source
+    (bitwise), counted as a wake fault — never a silent fresh start."""
+    vault = ScenarioTiering(str(tmp_path), residency_budget=1)
+    sp = scen_space(3)
+    vault.hibernate(9, sp, scen_model(), 4)
+    with inject.armed(FaultPlan((Fault("wake_corrupt", ticket=9,
+                                       nbytes=65536),))) as st:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            out, _ = vault.wake(9, fallback=lambda t: sp)
+    assert [f["kind"] for f in st.fired] == ["wake_corrupt"]
+    np.testing.assert_array_equal(np.asarray(out.values["value"]),
+                                  np.asarray(sp.values["value"]))
+    assert vault.counter.wake_faults == 1
+
+
+def test_wake_with_no_source_raises_loudly(tmp_path):
+    """The ladder's last rung: no verified chain record AND no journal
+    source → HibernationError, never fresh state."""
+    vault = ScenarioTiering(str(tmp_path), residency_budget=1)
+    vault.hibernate(2, scen_space(4), scen_model(), 4)
+    with inject.armed(FaultPlan((Fault("wake_corrupt",
+                                       nbytes=65536),))):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with pytest.raises(HibernationError, match="cannot wake"):
+                vault.wake(2)
+    assert vault.is_hibernated(2)  # the entry survives for drop()
+    vault.drop(2)
+    assert not vault.is_hibernated(2)
+
+
+def test_recover_restores_hibernated_set_fifo(tmp_path):
+    """Crash-restart: un-woken hibernations re-enter the tier (FIFO
+    preserved), woken/reclaimed ones do not; the model rebuilds from
+    its journaled wire recipe and the state wakes bitwise."""
+    vault = ScenarioTiering(str(tmp_path), residency_budget=1)
+    sp1, sp3 = scen_space(5), scen_space(6)
+    vault.hibernate(1, sp1, scen_model(2), 4)
+    vault.hibernate(2, scen_space(7), scen_model(), 4)
+    vault.wake(2)                       # woken: NOT recovered
+    vault.hibernate(3, sp3, scen_model(), 6)
+    vault.close()
+
+    v2 = ScenarioTiering(str(tmp_path), residency_budget=1)
+    hib = v2.recover(scen_model())
+    assert sorted(hib) == [1, 3]
+    assert v2.peek_next()[0] == 1       # FIFO: oldest hibernation first
+    assert hib[1].steps == 4 and hib[3].steps == 6
+    assert hib[1].model.flows[0].flow_rate == pytest.approx(0.07)
+    out, _ = v2.wake(1)
+    np.testing.assert_array_equal(np.asarray(out.values["value"]),
+                                  np.asarray(sp1.values["value"]))
+
+
+def test_recover_inflight_hibernation_wakes_from_prefix(tmp_path):
+    """The crash-IN-FLIGHT contract: the commit record torn off the
+    journal (intent survives) + the chain's newest record torn — the
+    recovered wake walks back to the previous verified record,
+    bitwise. Never a silent fresh start."""
+    vault = ScenarioTiering(str(tmp_path), residency_budget=1)
+    sp = scen_space(8)
+    vault.hibernate(4, sp, scen_model(), 4)
+    out, _ = vault.wake(4)
+    # the re-hibernation: chain record torn AND its commit journal
+    # record truncated — exactly what a kill mid-hibernation leaves
+    with inject.armed(FaultPlan((
+            Fault("hibernate_torn", at=1, nbytes=256),
+            Fault("journal_torn", at=4, tear="truncate", offset=0),))):
+        vault.hibernate(4, out, scen_model(), 4)
+    vault.close()
+
+    v2 = ScenarioTiering(str(tmp_path), residency_budget=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        hib = v2.recover(scen_model())
+        assert list(hib) == [4]
+        out2, _ = v2.wake(4)
+    np.testing.assert_array_equal(np.asarray(out2.values["value"]),
+                                  np.asarray(sp.values["value"]))
+
+
+def test_tiering_validation():
+    with pytest.raises(ValueError, match="residency_budget"):
+        ScenarioTiering("/tmp/nope-never-created", residency_budget=0)
+    with pytest.raises(ValueError, match="BOTH"):
+        AsyncEnsembleService(scen_model(), steps=4, start=False,
+                             residency_budget=100)
+    with pytest.raises(ValueError, match="BOTH"):
+        FleetSupervisor(scen_model(), steps=4, start=False,
+                        hibernate_dir="/tmp/nope")
+
+
+# -- service level: the paging overlay ---------------------------------------
+
+def service(tmp_path, budget, **kw):
+    kw.setdefault("steps", 4)
+    kw.setdefault("max_queue", 64)
+    return AsyncEnsembleService(
+        scen_model(), start=False, residency_budget=budget,
+        hibernate_dir=str(tmp_path / "vault"), **kw)
+
+
+def test_service_pages_instead_of_shedding_bitwise(tmp_path):
+    """Overload degrades to latency: a budget holding 2 of 6 scenarios
+    serves all 6 with zero sheds, every result bitwise-equal to the
+    sync twin."""
+    spaces = [scen_space(i) for i in range(6)]
+    models = [scen_model(i) for i in range(6)]
+    want = sync_twin(spaces, models)
+    svc = service(tmp_path, 2 * one_nbytes() + 1)
+    ts = [svc.submit(s, model=m) for s, m in zip(spaces, models)]
+    st = svc.stats()
+    assert st["hibernated_scenarios"] == 4 and st["shed"] == 0
+    for i, t in enumerate(ts):
+        out, _rep = svc.result(t)
+        np.testing.assert_array_equal(
+            np.asarray(out.values["value"]), want[i])
+    st = svc.stats()
+    assert st["wakes"] == 4 and st["shed"] == 0
+    assert st["hibernated_scenarios"] == 0
+    assert st["wake_latency_p99_s"] is not None
+    svc.stop()
+
+
+def test_service_lru_victim_pages_out(tmp_path):
+    """The LRU policy decides WHO hibernates: with the queue held open
+    (max-wait), a new arrival pages out the least-recently-touched
+    QUEUED resident instead of itself."""
+    svc = service(tmp_path, int(1.5 * one_nbytes()),
+                  max_wait_s=1e9, max_batch=8)
+    t_a = svc.submit(scen_space(0))
+    t_b = svc.submit(scen_space(1))   # pressure: A is the LRU victim
+    assert svc.tiering.is_hibernated(t_a)
+    assert not svc.tiering.is_hibernated(t_b)
+    assert svc.poll(t_a) is None      # hibernated polls None
+    st = svc.stats()
+    assert st["hibernations"] == 1 and st["shed"] == 0
+    svc.stop()
+    # the drain wakes and serves BOTH — nothing lost
+    assert svc.poll(t_a) is not None
+    assert svc.poll(t_b) is not None
+
+
+def test_service_hibernation_tier_exhausted_sheds(tmp_path):
+    """ServiceOverloaded fires only when the hibernation tier itself
+    is exhausted."""
+    svc = AsyncEnsembleService(
+        scen_model(), steps=4, start=False, max_wait_s=1e9, max_batch=8,
+        residency_budget=1, hibernate_dir=str(tmp_path / "v"),
+        hibernate_budget=one_nbytes())
+    svc.submit(scen_space(0))         # hibernates (budget=1 byte)
+    with pytest.raises(ServiceOverloaded,
+                       match="hibernation tier exhausted"):
+        svc.submit(scen_space(1))
+    assert svc.stats()["shed"] == 1
+    svc.stop()
+
+
+def test_service_deadline_expires_hibernated_ticket(tmp_path):
+    """A hibernated ticket past its deadline resolves as TicketExpired
+    with a complete FailureEvent — a deadline miss is observable, not
+    a silent drop, even in the paging tier."""
+    clock = {"t": 0.0}
+    svc = AsyncEnsembleService(
+        scen_model(), steps=4, start=False, deadline_s=5.0,
+        max_wait_s=1e9, max_batch=8, clock=lambda: clock["t"],
+        residency_budget=1, hibernate_dir=str(tmp_path / "v"))
+    t = svc.submit(scen_space(0))
+    assert svc.tiering.is_hibernated(t)
+    clock["t"] = 10.0
+    svc.pump_once()
+    with pytest.raises(TicketExpired, match="hibernation tier") as ei:
+        svc.poll(t)
+    assert ei.value.failure_event.kind == "expired"
+    assert svc.stats()["expired"] == 1
+    assert not svc.tiering.is_hibernated(t)
+    svc.stop()
+
+
+def test_service_residency_pressure_fault_forces_paging(tmp_path):
+    """The ``residency_pressure`` chaos seam: one admission behaves as
+    if the budget were exhausted — the scenario hibernates (and later
+    serves) without real memory pressure."""
+    svc = service(tmp_path, 10 * one_nbytes())
+    with inject.armed(FaultPlan((Fault("residency_pressure"),))) as st:
+        t0 = svc.submit(scen_space(0))
+        t1 = svc.submit(scen_space(1))
+    assert [f["kind"] for f in st.fired] == ["residency_pressure"]
+    assert svc.tiering.is_hibernated(t0)
+    assert not svc.tiering.is_hibernated(t1)
+    assert svc.result(t0) is not None and svc.result(t1) is not None
+    assert svc.stats()["shed"] == 0
+    svc.stop()
+
+
+def test_scheduler_allocate_ticket_is_monotonic(tmp_path):
+    svc = service(tmp_path, 10 * one_nbytes())
+    t0 = svc.submit(scen_space(0))
+    reserved = svc.scheduler.allocate_ticket()
+    t1 = svc.submit(scen_space(1))
+    assert t0 < reserved < t1
+    with pytest.raises(KeyError):
+        svc.scheduler.poll(reserved, pump=False)
+    svc.stop()
+
+
+# -- fleet level --------------------------------------------------------------
+
+def fleet(tmp_path, budget, **kw):
+    kw.setdefault("services", 2)
+    kw.setdefault("steps", 4)
+    return FleetSupervisor(
+        scen_model(), start=False, residency_budget=budget,
+        hibernate_dir=str(tmp_path / "fvault"), **kw)
+
+
+def test_fleet_pages_and_attributes_wakes_per_member(tmp_path):
+    """Fleet paging: refusals hibernate instead of shedding; wakes
+    place structure-affine and are attributed per member id."""
+    spaces = [scen_space(i) for i in range(8)]
+    models = [scen_model(i) for i in range(8)]
+    want = sync_twin(spaces, models)
+    f = fleet(tmp_path, 3 * one_nbytes() + 1,
+              journal_dir=str(tmp_path / "fj"))
+    ts = [f.submit(s, model=m) for s, m in zip(spaces, models)]
+    st = f.stats()
+    assert st["hibernated_scenarios"] == 5 and st["shed"] == 0
+    assert st["pending"] == 8          # hibernated tickets are pending
+    for i, t in enumerate(ts):
+        out, _rep = f.result(t)
+        np.testing.assert_array_equal(
+            np.asarray(out.values["value"]), want[i])
+    st = f.stats()
+    assert st["wakes"] == 5 and st["shed"] == 0
+    assert sum(st["wakes_by_member"].values()) == 5
+    assert all(k.startswith("m") for k in st["wakes_by_member"])
+    f.stop()
+    audit = replay(journal_path(str(tmp_path / "fj")))
+    assert not audit.unresolved() and not audit.duplicate_terminals
+
+
+def test_fleet_wake_survives_member_fence(tmp_path):
+    """A hibernated ticket belongs to NO member: fencing and
+    respawning a member while it sleeps changes nothing — the wake
+    lands on whichever healthy member the affinity router picks."""
+    spaces = [scen_space(i) for i in range(3)]
+    models = [scen_model(i) for i in range(3)]
+    want = sync_twin(spaces, models)
+    f = fleet(tmp_path, one_nbytes() + 1)
+    ts = [f.submit(s, model=m) for s, m in zip(spaces, models)]
+    assert f.stats()["hibernated_scenarios"] == 2
+    with inject.armed(FaultPlan((Fault("member_kill"),))):
+        f.pump_once()                  # one member's pump dies
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        f.pump_once()                  # fence + respawn gen+1
+    assert f.counter.respawns >= 1
+    for i, t in enumerate(ts):
+        out, _rep = f.result(t)
+        np.testing.assert_array_equal(
+            np.asarray(out.values["value"]), want[i])
+    assert f.stats()["shed"] == 0
+    f.stop()
+
+
+def test_fleet_wake_corrupt_readmits_from_fleet_journal(tmp_path):
+    """The integrated wake_corrupt row: chain damaged end to end → the
+    wake re-admits from the fleet journal's submit record, bitwise,
+    counted — never a fresh start, never a shed."""
+    sp = scen_space(0)
+    want = sync_twin([sp], [scen_model()])
+    f = fleet(tmp_path, 1, services=1, max_queue=2,
+              journal_dir=str(tmp_path / "fj"))
+    with inject.armed(FaultPlan((Fault("wake_corrupt",
+                                       nbytes=65536),))) as st:
+        t = f.submit(sp)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            out, _rep = f.result(t)
+    assert [x["kind"] for x in st.fired] == ["wake_corrupt"]
+    np.testing.assert_array_equal(np.asarray(out.values["value"]),
+                                  want[0])
+    assert f.stats()["wake_faults"] == 1
+    f.stop()
+
+
+def test_fleet_recover_reenters_hibernated_tickets(tmp_path):
+    """Kill-during-hibernate: tickets hibernated at the crash re-enter
+    the hibernation tier from their chains (not re-materialized),
+    resident ones re-admit from the journal, everything resolves
+    bitwise exactly once."""
+    spaces = [scen_space(i) for i in range(6)]
+    models = [scen_model(i) for i in range(6)]
+    want = sync_twin(spaces, models)
+    jd = str(tmp_path / "fj")
+    f = fleet(tmp_path, 2 * one_nbytes() + 1, journal_dir=jd,
+              max_wait_s=1e9, max_batch=8)
+    ts = [f.submit(s, model=m) for s, m in zip(spaces, models)]
+    assert f.stats()["hibernated_scenarios"] == 4
+    f.abandon()
+
+    r2 = FleetSupervisor.recover(
+        jd, scen_model(), services=2, steps=4, start=False,
+        residency_budget=2 * one_nbytes() + 1,
+        hibernate_dir=str(tmp_path / "fvault"))
+    assert r2.stats()["hibernated_scenarios"] == 4
+    for i, t in enumerate(ts):
+        out, _rep = r2.result(t)
+        np.testing.assert_array_equal(
+            np.asarray(out.values["value"]), want[i])
+    r2.stop()
+    audit = replay(journal_path(jd))
+    assert not audit.unresolved() and not audit.duplicate_terminals
+
+
+# -- the acceptance soak ------------------------------------------------------
+
+def test_acceptance_soak_10x_working_set_lockdep_armed(tmp_path):
+    """THE ISSUE 14 acceptance row: a working set 10× the residency
+    budget completes with ZERO sheds, bounded measured p99 wake
+    latency, every woken scenario bitwise-equal to its
+    never-hibernated twin — with the lockdep witness armed against the
+    static acquisition graph for the whole soak."""
+    from mpi_model_tpu.analysis.concurrency import static_lock_graph
+    from mpi_model_tpu.resilience import lockdep
+
+    n = 20
+    spaces = [scen_space(i % 4, g=8) for i in range(n)]
+    models = [scen_model(i % 4) for i in range(n)]
+    want = sync_twin(spaces[:4], models[:4], steps=2)
+    one = scenario_nbytes(spaces[0])
+    budget = max(one, one * n // 10)
+    clock = {"t": 0.0}
+    with lockdep.armed(allowed=static_lock_graph()) as witness:
+        f = FleetSupervisor(
+            scen_model(), services=2, steps=2, start=False,
+            max_queue=n, clock=lambda: clock["t"],
+            journal_dir=str(tmp_path / "aj"),
+            residency_budget=budget,
+            hibernate_dir=str(tmp_path / "av"))
+        ts = []
+        for i in range(n):
+            clock["t"] += 0.001
+            ts.append(f.submit(spaces[i], model=models[i]))
+        assert f.stats()["shed"] == 0
+        assert f.stats()["hibernated_scenarios"] >= n // 2
+        for i, t in enumerate(ts):
+            out, _rep = f.result(t)
+            np.testing.assert_array_equal(
+                np.asarray(out.values["value"]), want[i % 4])
+        st = f.stats()
+        f.stop()
+    assert witness.edges, "the witness saw no acquisitions"
+    witness.assert_clean()
+    assert st["shed"] == 0
+    assert st["wakes"] >= n // 2
+    assert st["wake_latency_p99_s"] is not None
+    assert st["wake_latency_p99_s"] < 5.0     # bounded, measured
+    audit = replay(journal_path(str(tmp_path / "aj")))
+    assert not audit.unresolved() and not audit.duplicate_terminals
+
+
+def test_bench_tiering_quick():
+    """The bench row end to end at smoke geometry: zero sheds, ledger
+    complete, bitwise, recovery audit green, delta paging measured."""
+    import bench as bench_mod
+
+    row = bench_mod.bench_tiering(grid=16, B=3, steps=2,
+                                  n_scenarios=12)
+    assert row["shed"] == 0 and row["served"] == 12
+    assert row["bitwise_ok"] and row["recovery_ok"]
+    assert row["hibernations"] > 0 and row["wakes"] > 0
+    assert row["wake_latency_p99_s"] is not None
+    assert 0 < row["delta_fraction_of_keyframe"] < 1
+
+
+def test_ladder_config12_quick():
+    from benchmarks.ladder import config12
+
+    row = config12(quick=True)
+    assert row["config"] == 12
+    assert row["shed"] == 0 and row["recovery_ok"]
+
+
+def test_cli_serve_tiering_json(tmp_path, capsys):
+    from mpi_model_tpu.cli import main
+
+    rc = main(["run", "--flow=diffusion", "--dimx=16", "--dimy=16",
+               "--steps=2", "--serve", "--serve-scenarios=6",
+               "--json", f"--hibernate-dir={tmp_path / 'v'}",
+               "--residency-budget=1"])
+    assert rc == 0
+    import json as _json
+
+    row = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["ledger_complete"] is True
+    assert row["served"] == 6 and row["shed"] == 0
+    assert row["hibernations"] >= 1 and row["wakes"] >= 1
+    assert "wake_latency_p99_s" in row
+    assert row["residency_budget"] == 1
+
+
+def test_cli_tiering_flag_validation(tmp_path):
+    from mpi_model_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="BOTH"):
+        main(["run", "--serve", "--residency-budget=100"])
+    with pytest.raises(SystemExit, match="add --serve"):
+        main(["run", "--residency-budget=100",
+              f"--hibernate-dir={tmp_path}"])
+
+
+def test_service_hibernation_write_failure_sheds_observably(tmp_path):
+    """An unwritable vault at the arrival-hibernate path sheds with
+    ServiceOverloaded (the ticket was never handed out) instead of
+    leaving a ghost registration (review finding)."""
+    svc = service(tmp_path, 1, max_wait_s=1e9, max_batch=8)
+
+    def broken_hibernate(*a, **kw):
+        raise OSError("vault full")
+
+    svc.tiering.hibernate = broken_hibernate
+    with pytest.raises(ServiceOverloaded,
+                       match="hibernation write failed"):
+        svc.submit(scen_space(0))
+    st = svc.stats()
+    assert st["shed"] == 1 and st["pending"] == 0
+    assert not svc._hib_meta
+    svc.stop()
+
+
+def test_manual_result_pages_one_at_a_time(tmp_path):
+    """A manual-mode result() pumps with force=True but must NOT drain
+    the whole hibernation tier back into memory — only a stop() drain
+    overrides the residency budget (review finding)."""
+    spaces = [scen_space(i) for i in range(4)]
+    want = sync_twin(spaces[:1], [scen_model()])
+    svc = service(tmp_path, 1)     # nothing fits: all 4 hibernate
+    ts = [svc.submit(s) for s in spaces]
+    assert svc.stats()["hibernated_scenarios"] == 4
+    out, _rep = svc.result(ts[0])
+    np.testing.assert_array_equal(np.asarray(out.values["value"]),
+                                  want[0])
+    # serving the FIRST ticket woke it (and nothing beyond what the
+    # idle rule allows) — the rest of the tier stayed on disk
+    assert svc.stats()["hibernated_scenarios"] >= 2
+    svc.stop()                     # the stop drain wakes the rest
+    assert svc.stats()["hibernated_scenarios"] == 0
+
+
+def test_recover_sweeps_orphaned_chains(tmp_path):
+    """A ticket woken before the crash (resident — the fleet journal
+    owns it) must not leak its chain directory across recover()
+    (review finding)."""
+    import os
+
+    vault = ScenarioTiering(str(tmp_path), residency_budget=1)
+    vault.hibernate(1, scen_space(0), scen_model(), 4)
+    vault.hibernate(2, scen_space(1), scen_model(), 4)
+    vault.wake(2)                  # resident at the "crash"
+    assert os.path.isdir(str(tmp_path / "t00000002"))
+    vault.close()
+
+    v2 = ScenarioTiering(str(tmp_path), residency_budget=1)
+    hib = v2.recover(scen_model())
+    assert list(hib) == [1]
+    assert os.path.isdir(str(tmp_path / "t00000001"))
+    assert not os.path.isdir(str(tmp_path / "t00000002"))  # swept
