@@ -511,6 +511,32 @@ def bench_ensemble(grid: int = 4096, B: int = 8, steps: int = 8,
     return row
 
 
+def _tracing_overhead(make_wall, reps: int = 1) -> Optional[float]:
+    """Measured tracing overhead on the soak driver (ISSUE 15
+    satellite): ``make_wall()`` runs one small soak and returns its
+    wall seconds; each rep runs it once with a fresh ENABLED tracer
+    and once with a DISABLED one (interleaved, so rig drift hits both
+    arms together) and the median of the per-rep ratios is returned —
+    the "cheap enough to leave on" claim in tracing.py's docstring as
+    a recorded number instead of an adjective."""
+    import statistics
+
+    from mpi_model_tpu.utils.tracing import Tracer, set_tracer
+
+    ratios = []
+    for _ in range(reps):
+        walls = {}
+        for mode in ("on", "off"):
+            prev = set_tracer(Tracer(enabled=(mode == "on")))
+            try:
+                walls[mode] = make_wall()
+            finally:
+                set_tracer(prev)
+        if walls["off"] > 0:
+            ratios.append(walls["on"] / walls["off"] - 1.0)
+    return statistics.median(ratios) if ratios else None
+
+
 def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
                   dtype_name: str = "float32", n_scenarios: int = 2000,
                   arrival_rate_hz: Optional[float] = None,
@@ -679,8 +705,13 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
         async_svc = AsyncEnsembleService(
             template, windows=windows, max_queue=max_queue,
             deadline_s=deadline_s, **kwargs)
+    import tempfile as _tempfile
+
+    snap_path = os.path.join(
+        _tempfile.mkdtemp(prefix="bench-serve-obs-"), "snapshot.json")
     with armed(plan) as arm_state, async_svc:
-        async_rep = run_soak(async_svc, scenarios, arrival_rate_hz=rate)
+        async_rep = run_soak(async_svc, scenarios, arrival_rate_hz=rate,
+                             snapshot_path=snap_path)
         # capture the dispatch log BEFORE the context exit tears the
         # fleet down: a wire member's log is an RPC, and a stopped
         # process fleet has closed its connections
@@ -700,6 +731,39 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
         d["donated_windows"] == d["windows"] for d in logged)
     occ_ratio = (async_rep["occupancy"] / sync_rep["occupancy"]
                  if sync_rep["occupancy"] else None)
+
+    # -- telemetry plane (ISSUE 15): the soak dumped the unified
+    # snapshot on an interval; gate its schema here so a bench row can
+    # never point at a document the obs CLI would reject
+    from mpi_model_tpu import obs as _obs
+
+    with open(snap_path) as _fh:
+        _obs.validate_snapshot(json.load(_fh))
+
+    # -- measured tracing overhead (ISSUE 15 satellite): a small
+    # open-throttle soak on a fresh single service, tracer on vs off,
+    # interleaved — runner caches are warm from the soak above, so
+    # this times steady-state dispatch, which is where the spans live
+    n_over = max(2 * B, 8)
+    over_scen = scenarios[:n_over]
+    with AsyncEnsembleService(template, windows=windows,
+                              max_queue=max_queue, **kwargs) as osvc:
+        run_soak(osvc, over_scen, arrival_rate_hz=1e9)  # warm runners
+
+        def _one_overhead_wall() -> float:
+            import time as _ot
+
+            t0 = _ot.perf_counter()
+            run_soak(osvc, over_scen, arrival_rate_hz=1e9)
+            return _ot.perf_counter() - t0
+
+        overhead = _tracing_overhead(
+            _one_overhead_wall, reps=3 if n_scenarios >= 500 else 2)
+    if overhead is not None and overhead > 0.02:
+        print(f"  WARNING: measured tracing overhead "
+              f"{overhead * 100:.2f}% exceeds the 2% budget "
+              "(tracing.py's cheap-enough-to-leave-on claim)",
+              file=sys.stderr)
 
     # -- fleet-only: the kill-restart recovery leg (ISSUE 10) — a
     # journaled fleet is hard-abandoned mid-run (simulated process
@@ -769,16 +833,48 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
             k9_stats = kf.stats()
             kf.stop()
             k9_audit = audit_journal(journal_path(kdir))
+            # -- ISSUE 15 acceptance on the REAL kill -9 leg: the
+            # merged Chrome trace must contain member-side spans
+            # (recorded in the CHILD processes, shipped over
+            # heartbeats) parented under this process's fleet-side
+            # submit spans, and obs.timeline must reconstruct a
+            # complete lifecycle for every served ticket
+            from mpi_model_tpu.utils.tracing import get_tracer
+
+            k9_trace = os.path.join(kdir, "kill9-trace.json")
+            get_tracer().export_chrome(k9_trace)
+            _spans = get_tracer().spans
+            _sub_ids = {s.span_id for s in _spans
+                        if s.name == "fleet.submit"}
+            k9_remote_parented = sum(
+                1 for s in _spans
+                if s.pid != os.getpid() and s.parent_id in _sub_ids)
+            # parse the merged trace ONCE — passing the path would
+            # re-open + re-json.load the whole artifact per ticket
+            from mpi_model_tpu.obs.postmortem import spans_from_chrome
+
+            k9_span_dicts = spans_from_chrome(k9_trace)
+            k9_incomplete = [
+                t for t in kts
+                if not _obs.timeline(t, journal_dir=kdir,
+                                     spans=k9_span_dicts).complete]
             kill9_ok = (k9_audit["ok"] and not k9_audit["unresolved"]
                         and k9_stats["respawns"] >= 1
                         and k9_stats["member_faults"] >= 1
-                        and k9_served == k9)
+                        and k9_served == k9
+                        and k9_remote_parented >= 1
+                        and not k9_incomplete)
             if not kill9_ok:
                 raise AssertionError(
                     f"kill -9 leg failed: served {k9_served}/{k9}, "
                     f"respawns={k9_stats['respawns']}, audit="
-                    f"{k9_audit}")
+                    f"{k9_audit}, remote_parented_spans="
+                    f"{k9_remote_parented}, incomplete_timelines="
+                    f"{k9_incomplete}")
             fleet_fields.update({
+                "kill9_trace": k9_trace,
+                "kill9_remote_parented_spans": k9_remote_parented,
+                "kill9_timeline_ok": not k9_incomplete,
                 "kill9_tickets": k9,
                 "kill9_served": k9_served,
                 "kill9_victim": victim["service_id"],
@@ -826,20 +922,30 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
                 pass
         r2.stop()
         audit = replay(journal_path(rdir))
+        # ISSUE 15: after recovery, EVERY ticket of the killed fleet
+        # must reconstruct a complete timeline from the journal alone
+        # (tickets in flight at the kill show their readmit records,
+        # never a silent gap)
+        r_incomplete = [t for t in rts
+                        if not _obs.timeline(
+                            t, journal_dir=rdir).complete]
         recovery_ok = (not audit.unresolved()
                        and not audit.duplicate_terminals
-                       and len(audit.submits) == k)
+                       and len(audit.submits) == k
+                       and not r_incomplete)
         if not recovery_ok:
             raise AssertionError(
                 f"kill-restart recovery audit failed: unresolved="
                 f"{audit.unresolved()} duplicates="
                 f"{audit.duplicate_terminals} submits="
-                f"{len(audit.submits)}/{k}")
+                f"{len(audit.submits)}/{k} incomplete_timelines="
+                f"{r_incomplete}")
         fleet_fields.update({
             "recovery_tickets": k,
             "recovery_served": recovered_served,
             "recovery_readmitted": rerun,
             "recovery_ok": recovery_ok,
+            "recovery_timeline_ok": not r_incomplete,
         })
         if verbose:
             print(f"  kill-restart: {k} tickets, {rerun} re-admitted "
@@ -881,6 +987,12 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
         "degraded_from": async_rep["degraded_from"],
         "chaos_fired": fired,
         "donation_ok": donation_ok,
+        # ISSUE 15: where the soak's telemetry-plane snapshot lives
+        # (schema-validated above) and the measured tracing overhead
+        # (enabled vs disabled on the soak driver, median of
+        # interleaved reps) — the "cheap enough to leave on" number
+        "telemetry_snapshot": snap_path,
+        "tracing_overhead_frac": overhead,
         **fleet_fields,
     }
 

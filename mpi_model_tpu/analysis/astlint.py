@@ -821,6 +821,82 @@ def check_wall_clock_in_test(ctx: ModuleCtx):
                 "genuine wall dependency with its reason)")
 
 
+# -- naked-timer rule (ISSUE 15 satellite) ------------------------------------
+# The serving stack now has a real observability layer: spans
+# (utils.tracing — trace-context ids, cross-process propagation, the
+# telemetry plane's per-stage rollups) and the shared LatencyReservoir
+# (utils.metrics). A raw `time.perf_counter()` / `time.monotonic()`
+# call in the ensemble modules is timing that BYPASSES both — it
+# produces a number nobody can correlate with a ticket, a stage or a
+# percentile. New timing should open a span or feed a reservoir; the
+# handful of reasoned sites (the occupancy span bridge, client-facing
+# wall deadlines, the wake-latency anchor, the wire's socket deadline
+# arithmetic) carry pragmas naming why they are not spans.
+# References (e.g. `clock=time.monotonic` as an injectable default)
+# are NOT calls and stay legal.
+
+#: the `time` attributes whose CALL in a serving module is naked timing
+_TIMER_ATTRS = {"perf_counter", "monotonic"}
+
+
+def _timer_imports(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(function names, module aliases) bound in this module that
+    resolve to the monotonic timers — same resolution discipline as
+    the wall-clock-in-test rule (only calls through a REAL time import
+    count; a fake-clock local named `time` cannot false-positive)."""
+    funcs: set[str] = set()
+    modules: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in _TIMER_ATTRS:
+                    funcs.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    modules.add(a.asname or a.name)
+    return funcs, modules
+
+
+def _serving_module(ctx: ModuleCtx) -> bool:
+    """The rule's scope: the ensemble serving modules. utils/tracing.py
+    and utils/metrics.py are the sanctioned timing layer (not under
+    ensemble/, so they are out of scope by construction)."""
+    parts = ctx.resolved_parts
+    return "ensemble" in parts[:-1]
+
+
+@rule("naked-timer", Severity.WARNING,
+      "direct time.perf_counter()/time.monotonic() timing in the "
+      "serving/ensemble modules — new timing should flow through "
+      "tracing spans or the metrics LatencyReservoir so it lands on "
+      "the telemetry plane (pragma a reasoned site)",
+      scope=SCOPE_PACKAGE)
+def check_naked_timer(ctx: ModuleCtx):
+    if not _serving_module(ctx):
+        return
+    from_imports, module_names = _timer_imports(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        hit = None
+        if (isinstance(fn, ast.Attribute) and fn.attr in _TIMER_ATTRS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in module_names):
+            hit = f"{fn.value.id}.{fn.attr}"
+        elif isinstance(fn, ast.Name) and fn.id in from_imports:
+            hit = fn.id
+        if hit is not None:
+            yield Finding(
+                "naked-timer", Severity.WARNING, ctx.path, node.lineno,
+                f"`{hit}(...)` in a serving module — time through "
+                "utils.tracing spans (correlatable, exported, rolled "
+                "up by the telemetry plane) or the "
+                "utils.metrics.LatencyReservoir, or pragma a reasoned "
+                "exception")
+
+
 def audit_test_module(path) -> list[str]:
     """Marker-audit compatibility surface for
     ``tests/test_marker_audit.py``: ``["file.py::test_name", ...]`` for

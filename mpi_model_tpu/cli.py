@@ -351,6 +351,15 @@ def _run_serve(args, space, model) -> int:
         # vault instead of shedding (both flags or neither, validated)
         residency_budget=args.residency_budget,
         hibernate_dir=args.hibernate_dir)
+    if args.status:
+        # --status is the "I am watching this soak" flag: flight dumps
+        # (the ring cut beside every fence/quarantine/HibernationError)
+        # land on disk next to the snapshot so a post-mortem finds them
+        # even if this process died with its in-memory dumps
+        from .obs.flight import FlightRecorder, set_recorder
+
+        set_recorder(FlightRecorder(
+            dump_dir=args.status + ".flight.d"))
     fleet_mode = (args.serve_services > 1
                   or args.serve_transport != "inproc")
     if fleet_mode:
@@ -364,7 +373,15 @@ def _run_serve(args, space, model) -> int:
     rate = args.arrival_rate if args.arrival_rate else 1e9
     with svc:
         rep = run_soak(svc, [(space, None, None)] * n,
-                       arrival_rate_hz=rate)
+                       arrival_rate_hz=rate,
+                       snapshot_path=args.status,
+                       snapshot_interval_s=args.status_interval_s)
+    if args.trace:
+        # serve mode: the merged ticket-flight trace (member spans
+        # arrived over heartbeats, labeled m<slot>g<gen>)
+        from .utils.tracing import get_tracer
+
+        get_tracer().export_chrome(args.trace)
     result = {
         "backend": "serve",
         "impl": args.ensemble_impl,
@@ -373,6 +390,8 @@ def _run_serve(args, space, model) -> int:
         "deadline_s": args.deadline_s,
         "services": args.serve_services,
         "transport": args.serve_transport,
+        "telemetry_snapshot": args.status,
+        "trace": args.trace,
         **{k: rep[k] for k in (
             "offered", "served", "failed", "expired", "shed",
             "ledger_complete", "wall_s", "sustained_scenarios_per_s",
@@ -573,7 +592,9 @@ def cmd_run(args) -> int:
                 ("--serve-services", args.serve_services, 1),
                 ("--serve-transport", args.serve_transport, "inproc"),
                 ("--residency-budget", args.residency_budget, None),
-                ("--hibernate-dir", args.hibernate_dir, None)):
+                ("--hibernate-dir", args.hibernate_dir, None),
+                ("--status", args.status, None),
+                ("--status-interval-s", args.status_interval_s, 5.0)):
             if val != default:
                 raise SystemExit(
                     f"{flag} configures the always-on serving loop; "
@@ -1012,7 +1033,23 @@ def main(argv: Optional[list[str]] = None) -> int:
                      help="write the reference-parity per-rank dump + "
                      "merged output file to this directory")
     run.add_argument("--trace", default=None,
-                     help="write a Chrome trace of the run's phases")
+                     help="write a Chrome trace of the run's phases "
+                     "(serve mode: the merged multi-process ticket "
+                     "trace, member spans labeled m<slot>g<gen>)")
+    run.add_argument("--status", default=None, metavar="PATH",
+                     help="dump the unified telemetry-plane snapshot "
+                     "(obs.fleet_snapshot: serving stats + per-member "
+                     "cuts + tiering residency + tracer rollups + "
+                     "flight-recorder ledger, one versioned JSON "
+                     "document) to PATH — during a --serve soak every "
+                     "--status-interval-s, plus a final cut; validate "
+                     "or scrape it with python -m mpi_model_tpu.obs. "
+                     "Also arms on-disk flight-recorder dumps under "
+                     "PATH.flight.d/")
+    run.add_argument("--status-interval-s", type=float, default=5.0,
+                     metavar="S",
+                     help="seconds between --status snapshot dumps "
+                     "during a soak (default 5)")
     run.add_argument("--json", action="store_true")
     run.set_defaults(fn=cmd_run)
 

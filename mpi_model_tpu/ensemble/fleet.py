@@ -69,8 +69,10 @@ import warnings
 from typing import Callable, Optional
 
 from ..core.cellular_space import CellularSpace
+from ..obs.flight import get_recorder
 from ..resilience import inject, lockdep
 from ..utils.metrics import ThroughputCounter
+from ..utils.tracing import TraceContext, get_tracer
 from .batch import structure_key
 from .journal import (TicketJournal, journal_path, model_from_meta,
                       model_meta, replay, space_from_record, space_payload)
@@ -150,6 +152,11 @@ class _Route:
     model: object
     steps: int
     submitted_at: float
+    #: the fleet submit span's TraceContext (ISSUE 15) — re-admissions
+    #: and wakes re-attach it, so a ticket's whole flight (including
+    #: across a fence) stays one trace; also journaled on the submit
+    #: record so obs.timeline can join spans offline
+    trace: Optional[object] = None
 
 
 class MemberFailure(RuntimeError):
@@ -357,6 +364,7 @@ class FleetSupervisor:
             # observability: how many times this fleet replaced a
             # member in place (fence → gen+1)
             self.counter.bump("respawns")
+            get_recorder().record("respawn", service_id=sid)
         return _Member(service=svc, slot=slot, gen=gen,
                        progress_t=self._clock())
 
@@ -472,8 +480,21 @@ class FleetSupervisor:
         """Admit one scenario to the fleet, or raise
         :class:`ServiceOverloaded` when EVERY member refuses. Routing is
         structure-affine (docstring); the returned ticket is a
-        fleet-level id, stable across member fencing and migration."""
+        fleet-level id, stable across member fencing and migration.
+
+        The admission runs inside a ``fleet.submit`` span (ISSUE 15):
+        its context rides the ticket (``_Route.trace``, the journal
+        submit record, the wire's trace meta), so every downstream
+        dispatch span — member-side included — parents under it."""
+        with get_tracer().span("fleet.submit") as sm:
+            ticket = self._submit_traced(space, model, steps)
+            sm["ticket"] = ticket
+            return ticket
+
+    def _submit_traced(self, space: CellularSpace, model,
+                       steps: Optional[int]) -> int:
         m_model = self.model if model is None else model
+        trace = get_tracer().current()
         n = self.default_steps if steps is None else int(steps)
         skey = structure_key(m_model, space) + (n,)
         nbytes = scenario_nbytes(space)
@@ -504,7 +525,7 @@ class FleetSupervisor:
                 ticket = next(self._ids)
                 route = _Route(member=mem, member_ticket=mt, space=space,
                                model=m_model, steps=n,
-                               submitted_at=self._clock())
+                               submitted_at=self._clock(), trace=trace)
                 self._route[ticket] = route
                 self._journal_submit_locked(ticket, route)
                 if self.tiering is not None:
@@ -520,12 +541,13 @@ class FleetSupervisor:
                 # wake path's last-resort source.
                 ticket = next(self._ids)
                 self._journal_submit_hibernated_locked(
-                    ticket, space, m_model, n)
+                    ticket, space, m_model, n, trace)
                 self._hib_meta[ticket] = (m_model, n, skey,
-                                          self._clock())
+                                          self._clock(), trace)
             else:
                 ticket = None
                 self.counter.bump("shed")
+                get_recorder().record("shed", service_id=None)
                 depth = sum(m.service.scheduler.pending_count()
                             for m in order)
                 self._journal_append_locked("shed", {
@@ -632,8 +654,15 @@ class FleetSupervisor:
     def result(self, ticket: int, timeout: Optional[float] = None):
         """Block until ``ticket`` resolves; ``TimeoutError`` after
         ``timeout`` wall seconds. Manual mode pumps synchronously."""
-        deadline = (None if timeout is None
-                    else time.monotonic() + float(timeout))
+        # analysis: ignore[naked-timer] — result()'s timeout= is a
+        # CLIENT-facing wall bound, not a measurement: nothing is
+        # recorded, so a span would be noise
+        deadline = (
+            # analysis: ignore[naked-timer] — client wall bound (see
+            # the pragma block above), not a measurement
+            None if timeout is None
+            # analysis: ignore[naked-timer] — same bound
+            else time.monotonic() + float(timeout))
         while True:
             res = self.poll(ticket)
             if res is not None:
@@ -649,7 +678,10 @@ class FleetSupervisor:
                         "found work — fleet state is inconsistent")
                 continue
             with self._cv:
+                # analysis: ignore[naked-timer] — the same client wall
+                # bound's expiry check (no measurement recorded)
                 if (deadline is not None
+                        # analysis: ignore[naked-timer] — same bound
                         and time.monotonic() >= deadline):
                     raise TimeoutError(
                         f"fleet ticket {ticket} still pending after "
@@ -758,6 +790,7 @@ class FleetSupervisor:
             except Exception:
                 self.counter.bump("loop_faults")
                 failed_reqs.append((slot, gen))
+        completed_fences = []
         with self._cv:
             if not self._abandoned:
                 self._pending_spawns.extend(failed_reqs)
@@ -780,7 +813,16 @@ class FleetSupervisor:
                         self._pending_fences.append((m, reason))
                         continue
                     self._complete_fence_locked(m, reason)
+                    completed_fences.append(m)
                 self._cv.notify_all()
+        # the flight-recorder dump rides BESIDE each fence's
+        # FailureEvent (ISSUE 15), outside the fleet lock — the dump
+        # may write a file, and the ring already holds the run-up.
+        # Only COMPLETED fences dump: a deferred fence re-enters
+        # to_fence every tick until its respawn lands, and dumping it
+        # per tick would churn the bounded dump ledger with duplicates
+        for m in completed_fences:
+            get_recorder().dump("fence", service_id=m.service_id)
         self._wake_due()
         for m in retired:
             try:
@@ -1025,6 +1067,11 @@ class FleetSupervisor:
             attempt=m.gen + 1, wall_time_s=0.0,
             classification="transient", service_id=m.service_id))
         self.counter.bump("member_faults")
+        # record only here (this runs under the fleet lock); the
+        # ring DUMP beside the FailureEvent happens in tick()'s
+        # unlocked phase (ISSUE 15)
+        get_recorder().record("fence", service_id=m.service_id,
+                              reason=reason)
 
     def _fence_locked(self, m: _Member, reason: str
                       ) -> Optional[tuple]:
@@ -1149,12 +1196,14 @@ class FleetSupervisor:
         skey = structure_key(route.model, route.space) + (route.steps,)
         for target in self._candidates_locked(skey):
             try:
-                # analysis: ignore[blocking-under-lock] — re-admission
-                # must be atomic with the route table, and members run
-                # inline_dispatch=False: the scheduler's inline-dispatch
-                # tail the auditor sees is unreachable on this path
-                new_mt = target.service.scheduler.submit(
-                    route.space, route.model, route.steps)
+                with get_tracer().attach(route.trace):
+                    # analysis: ignore[blocking-under-lock] — re-admission must be atomic
+                    # with the route table, and members run
+                    # inline_dispatch=False: the scheduler's
+                    # inline-dispatch tail the auditor sees is
+                    # unreachable on this path
+                    new_mt = target.service.scheduler.submit(
+                        route.space, route.model, route.steps)
             except WireError:
                 # a rescue target whose own wire is dead: mark it (its
                 # fencing is the next health check's) and try the next
@@ -1284,7 +1333,7 @@ class FleetSupervisor:
                     # vault lock is a leaf
                     self.tiering.drop(ticket)
                     continue
-                model, steps, skey, submitted_at = meta
+                model, steps, skey, submitted_at, trace = meta
                 live = [m for m in self._members.values()
                         if not m.fenced and not m.dead
                         and not m.retiring]
@@ -1305,16 +1354,23 @@ class FleetSupervisor:
                     did += 1
                     continue
             try:
-                space, entry = self.tiering.wake(
-                    ticket, fallback=self._journal_state_fallback)
+                # the wake re-attaches the ticket's submit-span context
+                # (ISSUE 15): the tiering.wake span parents under it,
+                # so a paged-out flight reads as one trace
+                with get_tracer().attach(trace):
+                    space, entry = self.tiering.wake(
+                        ticket, fallback=self._journal_state_fallback)
             except HibernationError as e:
                 with self._cv:
                     self._resolve_hibernated_locked(ticket, e, steps)
+                # dump OUTSIDE the fleet lock (the recorder dump may
+                # touch the filesystem)
+                get_recorder().dump("hibernation", ticket=ticket)
                 did += 1
                 continue
             placed = self._place_woken(ticket, space, model, steps,
                                        skey, submitted_at, nbytes,
-                                       bypass=False)
+                                       bypass=False, trace=trace)
             if not placed:
                 # every member refused mid-wake: back to the head; the
                 # next tick retries once capacity really freed
@@ -1324,7 +1380,7 @@ class FleetSupervisor:
 
     def _place_woken(self, ticket: int, space, model, steps: int,
                      skey, submitted_at, nbytes: int,
-                     bypass: bool) -> bool:
+                     bypass: bool, trace=None) -> bool:
         """Route one woken scenario onto a live member and install its
         route (atomic with the route table). ``bypass=True`` submits
         scheduler-level (the stop()-drain path — an admitted ticket is
@@ -1334,18 +1390,23 @@ class FleetSupervisor:
                 skey = structure_key(model, space) + (steps,)
             for mem in self._candidates_locked(skey):
                 try:
-                    if bypass:
-                        # analysis: ignore[blocking-under-lock] — the
-                        # re-admission contract of _readmit_locked:
-                        # placement must be atomic with the route
-                        # table; members run inline_dispatch=False
-                        mt = mem.service.scheduler.submit(
-                            space, model, steps)
-                    else:
-                        # analysis: ignore[blocking-under-lock] — same
-                        # contract as submit()'s admission routing
-                        mt = mem.service.submit(space, model=model,
-                                                steps=steps)
+                    # the ticket's submit-span context re-attaches for
+                    # the placement (ISSUE 15): member dispatch spans
+                    # keep parenting under the original submit span
+                    # even after a hibernation round trip
+                    with get_tracer().attach(trace):
+                        if bypass:
+                            # analysis: ignore[blocking-under-lock] — the re-admission
+                            # contract of _readmit_locked: placement
+                            # must be atomic with the route table;
+                            # members run inline_dispatch=False
+                            mt = mem.service.scheduler.submit(
+                                space, model, steps)
+                        else:
+                            # analysis: ignore[blocking-under-lock] — same contract as
+                            # submit()'s admission routing
+                            mt = mem.service.submit(space, model=model,
+                                                    steps=steps)
                 except ServiceOverloaded:
                     continue
                 except WireError:
@@ -1354,7 +1415,8 @@ class FleetSupervisor:
                     continue
                 self._route[ticket] = _Route(
                     member=mem, member_ticket=mt, space=space,
-                    model=model, steps=steps, submitted_at=submitted_at)
+                    model=model, steps=steps, submitted_at=submitted_at,
+                    trace=trace)
                 self._hib_meta.pop(ticket, None)
                 self.tiering.admit(ticket, nbytes)
                 sid = mem.service_id
@@ -1458,16 +1520,19 @@ class FleetSupervisor:
                     # _wake_due); the vault lock is a leaf
                     self.tiering.drop(ticket)
                     continue
-            model, steps, skey, submitted_at = meta
+            model, steps, skey, submitted_at, trace = meta
             try:
-                space, _entry = self.tiering.wake(
-                    ticket, fallback=self._journal_state_fallback)
+                with get_tracer().attach(trace):
+                    space, _entry = self.tiering.wake(
+                        ticket, fallback=self._journal_state_fallback)
             except HibernationError as e:
                 with self._cv:
                     self._resolve_hibernated_locked(ticket, e, steps)
+                get_recorder().dump("hibernation", ticket=ticket)
                 continue
             if not self._place_woken(ticket, space, model, steps, skey,
-                                     submitted_at, nbytes, bypass=True):
+                                     submitted_at, nbytes, bypass=True,
+                                     trace=trace):
                 with self._cv:
                     self._resolve_hibernated_locked(
                         ticket, MemberFailure(
@@ -1476,7 +1541,8 @@ class FleetSupervisor:
                             "hibernated"), steps)
 
     def _journal_submit_hibernated_locked(self, ticket: int, space,
-                                          model, steps: int) -> None:
+                                          model, steps: int,
+                                          trace=None) -> None:
         if self.journal is None:
             return
         # analysis: ignore[blocking-under-lock] — the documented
@@ -1488,6 +1554,8 @@ class FleetSupervisor:
         meta.update({
             "ticket": ticket, "service_id": "hibernated",
             "steps": steps, "model": model_meta(model)})
+        if trace is not None:
+            meta["trace"] = trace.to_meta()
         self._journal_append_locked("submit", meta, arrays)
 
     # -- autoscaling ---------------------------------------------------------
@@ -1559,6 +1627,10 @@ class FleetSupervisor:
         meta.update({
             "ticket": ticket, "service_id": route.member.service_id,
             "steps": route.steps, "model": model_meta(route.model)})
+        if route.trace is not None:
+            # the trace id rides the submit record (ISSUE 15): the
+            # offline timeline joins exported spans through it
+            meta["trace"] = route.trace.to_meta()
         self._journal_append_locked("submit", meta, arrays)
 
     @classmethod
@@ -1633,12 +1705,17 @@ class FleetSupervisor:
                 hib.pop(t)
             for t in state.unresolved():
                 rec = state.submits[t]
+                # the journaled trace context survives the crash: the
+                # post-restart spans keep the ticket's original
+                # trace_id, so obs.timeline's span join still sees one
+                # flight across the kill
+                trace = TraceContext.from_meta(rec.meta.get("trace"))
                 if t in hib:
                     e = hib[t]
                     fleet._hib_meta[t] = (
                         e.model, e.steps or rec.meta.get(
                             "steps", fleet.default_steps),
-                        None, fleet._clock())
+                        None, fleet._clock(), trace)
                     continue
                 # analysis: ignore[blocking-under-lock] — recovery
                 # replays before any client traffic exists (see above)
@@ -1654,7 +1731,7 @@ class FleetSupervisor:
                     member=None, member_ticket=-1, space=sp,
                     model=m_model, steps=rec.meta.get("steps",
                                                       fleet.default_steps),
-                    submitted_at=fleet._clock())
+                    submitted_at=fleet._clock(), trace=trace)
                 fleet._route[t] = route
                 fleet._readmit_locked(t, route, "crash-restart recovery")
         return fleet

@@ -55,6 +55,7 @@ import dataclasses
 import json
 import os
 import re
+import time
 import zlib
 from typing import Optional
 
@@ -144,9 +145,14 @@ class TicketJournal:
         """Write one CRC'd record and flush; returns its index. The
         ``journal_torn`` chaos seam fires AFTER the write, with the
         record's byte offset, so a torn-tail fault lands exactly where
-        a real mid-record crash would."""
+        a real mid-record crash would. Every record is stamped with
+        ``t_wall`` (epoch seconds) at append time — the ordering anchor
+        ``obs.timeline`` joins journal records against wall-anchored
+        spans with (record INDEX stays the authoritative order within
+        one journal; the stamp is for cross-source merges)."""
         body = dict(meta or {})
         body["kind"] = kind
+        body.setdefault("t_wall", time.time())
         # ONE payload format for the journal and the fleet wire
         # (ISSUE 13 lifted it into ensemble.wire): a journal record and
         # a wire message differ only in their envelope

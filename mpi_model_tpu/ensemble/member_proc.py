@@ -65,12 +65,17 @@ import time
 from typing import Callable, Optional
 
 from ..core.cellular_space import CellularSpace
+# the telemetry JSON projection is the SHARED one (ISSUE 15): the
+# heartbeat stats cuts here and obs.fleet_snapshot's plane must
+# project identically, so there is exactly one implementation
+from ..obs import jsonable as _jsonable
 from ..resilience import inject
+from ..utils.tracing import TraceContext, get_tracer
 from .journal import model_from_meta, model_meta, space_payload
 from .scheduler import (EnsembleScheduler, TicketExpired,
                         TicketNotMigratable)
 from .service import AsyncEnsembleService, ServiceOverloaded
-from .wire import FrameConn, RemoteError, WireError
+from .wire import TRACE_META_KEY, FrameConn, RemoteError, WireError
 
 __all__ = [
     "MemberServer",
@@ -94,29 +99,6 @@ SPAWNABLE_KWARGS = frozenset((
 #: how long the spawner waits for the child to import jax, build its
 #: service and connect back (a cold jax import dominates this)
 SPAWN_CONNECT_TIMEOUT_S = 180.0
-
-
-def _jsonable(x):
-    """Best-effort JSON projection for stats/report payloads: numpy
-    scalars become Python numbers, arrays become lists, unknown objects
-    become their repr — telemetry must never fail to serialize."""
-    import numpy as np
-
-    if isinstance(x, dict):
-        return {str(k): _jsonable(v) for k, v in x.items()}
-    if isinstance(x, (list, tuple)):
-        return [_jsonable(v) for v in x]
-    if isinstance(x, (np.integer,)):
-        return int(x)
-    if isinstance(x, (np.floating,)):
-        return float(x)
-    if isinstance(x, np.ndarray):
-        return x.tolist()
-    if isinstance(x, (str, int, bool, type(None))):
-        return x
-    if isinstance(x, float):
-        return x
-    return repr(x)
 
 
 def _space_from_payload(meta: dict, arrays: Optional[dict]
@@ -192,12 +174,19 @@ class MemberServer:
     supervisor link is broken has no caller left to serve."""
 
     def __init__(self, service: AsyncEnsembleService, conn: FrameConn,
-                 pump: str = "thread"):
+                 pump: str = "thread", ship_spans: bool = True):
         if pump not in ("thread", "rpc"):
             raise ValueError(f"unknown pump mode {pump!r}")
         self.service = service
         self.conn = conn
         self.pump = pump
+        #: ship completed-span deltas on heartbeats (ISSUE 15). The
+        #: loopback transport turns this OFF: its server shares the
+        #: supervisor's process tracer, so every shipped delta would
+        #: be JSON-encoded, sent over the socketpair and then
+        #: discarded at ingest by the same-pid check — wasted bytes
+        #: on the liveness path (the spans are already in the ring)
+        self.ship_spans = bool(ship_spans)
         # single serve thread owns all state above; the flags below are
         # poked cross-thread by the loopback kill path, hence the lock
         # (a plain leaf lock: nothing is ever acquired under it)
@@ -213,6 +202,10 @@ class MemberServer:
         #: sort + JSON re-encode (the hot liveness path must stay cheap)
         self._stats_key = None
         self._stats_cached: dict = {}
+        #: span-delta cursor (ISSUE 15): each heartbeat ships only the
+        #: spans recorded since the previous beat — the supervisor
+        #: ingests them into its own tracer ring
+        self._span_cursor = 0
 
     def hard_stop(self) -> None:
         """The loopback stand-in for ``SIGKILL``: close the serve
@@ -315,19 +308,26 @@ class MemberServer:
         space = _space_from_payload(meta, arrays)
         model = model_from_meta(meta.get("model"), self.service.model)
         steps = meta.get("steps")
+        # the frame's trace context (ISSUE 15): attach it around the
+        # admission so this member's dispatch spans parent under the
+        # FLEET-side submit span — the cross-process half of the trace
+        ctx = TraceContext.from_meta(meta.get(TRACE_META_KEY))
         if meta.get("bypass"):
             # the fleet's re-admission/migration path: scheduler-level
             # submit, no admission bound (an already-admitted ticket
             # must not be shed by its rescue)
             sched = self.service.scheduler
-            ticket = sched.submit(space, model, steps)
+            with get_tracer().attach(ctx):
+                ticket = sched.submit(space, model, steps)
             if meta.get("migrated"):
                 with sched._lock:
                     sched.migrated_in += 1
             self.conn.send("ok", {"ticket": ticket})
             return False
         try:
-            ticket = self.service.submit(space, model=model, steps=steps)
+            with get_tracer().attach(ctx):
+                ticket = self.service.submit(space, model=model,
+                                             steps=steps)
         except ServiceOverloaded as e:
             self.conn.send("overloaded", {
                 "detail": str(e), "queue_depth": e.queue_depth,
@@ -441,6 +441,20 @@ class MemberServer:
                 self._stats_cached = _jsonable(svc.stats())
                 self._stats_key = key
             stats = self._stats_cached
+            cursor = self._span_cursor
+        # completed-span deltas ride the SAME telemetry cut (ISSUE 15):
+        # computed OUTSIDE the stats cache — new spans do not
+        # necessarily move the counter signature, and a cached cut must
+        # never re-ship (duplicate) an already-shipped delta. Projected
+        # through _jsonable like the stats cut: one exotic span-meta
+        # value (a numpy scalar) must degrade to its repr, never kill a
+        # healthy member's heartbeat reply mid-serialize.
+        spans: list = []
+        if self.ship_spans:
+            new_cursor, spans = get_tracer().spans_since(cursor)
+            spans = _jsonable(spans)
+            with self._lock:
+                self._span_cursor = new_cursor
         return {
             "pending": pending,
             "due": svc.has_work_due(),
@@ -452,6 +466,7 @@ class MemberServer:
             "rss_bytes": _rss_bytes(),
             "pid": os.getpid(),
             "stats": stats,
+            "spans": spans,
         }
 
 
@@ -658,6 +673,12 @@ class ProcessMemberClient:
     def _scenario_payload(self, space: CellularSpace, model,
                           steps: Optional[int]) -> tuple[dict, dict]:
         meta, arrays = space_payload(space)
+        # the caller's trace context crosses in the frame meta
+        # (ISSUE 15): the fleet's submit span is open here, so the
+        # member's dispatch spans parent under it across the wire
+        ctx = get_tracer().current()
+        if ctx is not None:
+            meta[TRACE_META_KEY] = ctx.to_meta()
         if model is not None:
             recipe = model_meta(model)
             if recipe is None:
@@ -726,9 +747,20 @@ class ProcessMemberClient:
             return False
         if kind != "ok":
             return False
+        telemetry = meta.get("telemetry", {})
         with self._lock:
-            self._telemetry = meta.get("telemetry", {})
+            self._telemetry = telemetry
             self._last_beat = self._clock()
+        # absorb the member's completed-span delta into the supervisor
+        # tracer (ISSUE 15): ingest() keys spans by their recording pid
+        # — a loopback member shares this process's tracer, so its
+        # spans are skipped rather than duplicated; a real child's
+        # spans merge in wall-anchored and labeled m<slot>g<gen>. A
+        # member that dies between beats loses only its unshipped tail
+        # (exactly like in-flight wire bytes).
+        spans = telemetry.get("spans")
+        if spans:
+            get_tracer().ingest(spans, label=self.service_id)
         return True
 
     def heartbeat_age(self) -> float:
@@ -983,7 +1015,11 @@ def spawn_loopback_member(model, *, service_id: str, member_kwargs: dict,
     service = AsyncEnsembleService(
         member_model, start=(pump_mode == "thread"),
         service_id=service_id, **kwargs)
-    server = MemberServer(service, FrameConn(s_sock), pump=pump_mode)
+    # ship_spans=False: the loopback server shares the supervisor's
+    # process tracer — its spans are already in the ring, and shipping
+    # them over the socketpair would only be discarded at ingest
+    server = MemberServer(service, FrameConn(s_sock), pump=pump_mode,
+                          ship_spans=False)
     t = threading.Thread(target=server.serve_forever, daemon=True,
                          name=f"member-serve-{service_id}")
     t.start()

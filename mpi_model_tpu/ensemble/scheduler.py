@@ -87,8 +87,10 @@ import warnings
 from typing import Callable, Optional, Sequence
 
 from ..core.cellular_space import CellularSpace
+from ..obs.flight import get_recorder
 from ..resilience import inject, lockdep
 from ..utils.metrics import ThroughputCounter
+from ..utils.tracing import get_tracer
 from .batch import (EnsembleExecutor, complete_ensemble, launch_ensemble,
                     padding_scenarios, structure_key)
 
@@ -135,6 +137,11 @@ class _Pending:
     model: object
     steps: int
     submitted_at: float
+    #: the TraceContext current at submission (ISSUE 15) — dispatch
+    #: spans (assemble/launch/fetch) parent under it, so a member-side
+    #: span chains back to the fleet-side submit span even across the
+    #: wire (the context crossed in the submit frame's meta)
+    trace: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -288,12 +295,20 @@ class EnsembleScheduler:
         ``inline_dispatch=False`` — then the pump thread owns it)."""
         steps = model.num_steps if steps is None else int(steps)
         key = structure_key(model, space) + (steps,)
+        # the submitter's current trace context rides the ticket: a
+        # caller that opened a span (the fleet's submit span — locally
+        # or re-attached from the wire's trace meta) becomes the parent
+        # of every dispatch span this scenario generates
+        trace = get_tracer().current()
         with self._lock:
             ticket = next(self._ids)
             self._queues.setdefault(key, []).append(
-                _Pending(ticket, space, model, steps, self._clock()))
+                _Pending(ticket, space, model, steps, self._clock(),
+                         trace))
             self._pending_tickets.add(ticket)
             full = len(self._queues[key]) >= self.max_batch
+        get_recorder().record("submit", service_id=self.service_id,
+                              ticket=ticket, steps=steps)
         if full and self.inline_dispatch:
             self._dispatch_group(key)
         return ticket
@@ -426,6 +441,10 @@ class EnsembleScheduler:
         self._results[it.ticket] = err
         self._pending_tickets.discard(it.ticket)
         self.counter.bump("expired")
+        # record only (no dump): this runs under the scheduler lock,
+        # and a flight-recorder dump may touch the filesystem
+        get_recorder().record("expired", service_id=self.service_id,
+                              ticket=it.ticket, queued_s=age)
 
     # -- flush policy --------------------------------------------------------
 
@@ -626,6 +645,19 @@ class EnsembleScheduler:
             return
         self.finish_flight(flight)
 
+    def _span_meta(self, items: list, bucket: int) -> dict:
+        """Dispatch-span meta (ISSUE 15): the tickets in this batch and
+        EVERY ticket's trace id — the span itself can only parent under
+        one context (the first item's), so the other lanes correlate
+        through ``trace_ids`` (``obs.timeline`` matches on either)."""
+        return {
+            "tickets": [it.ticket for it in items],
+            "trace_ids": [it.trace.trace_id for it in items
+                          if it.trace is not None],
+            "bucket": bucket,
+            "service_id": self.service_id,
+        }
+
     def _launch_batch(self, items: list, bucket: int):
         """Assemble, pad, resolve/compile and DISPATCH ``items`` as one
         batch (no fetch): ``(_Flight, None)``, or ``(None, err)`` when
@@ -635,11 +667,14 @@ class EnsembleScheduler:
         template = items[0].model
         spaces = [it.space for it in items]
         models = [it.model for it in items]
-        if bucket > k:
-            pspaces, pmodels = padding_scenarios(template, spaces[0],
-                                                 bucket - k)
-            spaces += pspaces
-            models += pmodels
+        tracer = get_tracer()
+        with tracer.span("ensemble.assemble", parent=items[0].trace,
+                         **self._span_meta(items, bucket)):
+            if bucket > k:
+                pspaces, pmodels = padding_scenarios(template, spaces[0],
+                                                     bucket - k)
+                spaces += pspaces
+                models += pmodels
         # chaos seams (resilience.inject): ticket-bound lane poisons are
         # mapped to lane indices and pushed for the launch (the capture
         # window); "batch_exc" fails this whole dispatch; "slow_compile"
@@ -670,11 +705,19 @@ class EnsembleScheduler:
                 if sf is not None:
                     extra_s = sf.seconds
             donate = self.donate and self.executor.impl == "xla"
-            inflight = launch_ensemble(
-                template, spaces, models=models, executor=self.executor,
-                steps=items[0].steps, count=k,
-                windows=self.windows if self.executor.impl == "xla" else 1,
-                donate=donate)
+            # "launch" covers runner resolution too: on a runner-cache
+            # miss the compile happens inside — cache_hit in the span
+            # meta says which it was
+            with tracer.span("ensemble.launch", parent=items[0].trace,
+                             **self._span_meta(items, bucket)) as sm:
+                inflight = launch_ensemble(
+                    template, spaces, models=models,
+                    executor=self.executor,
+                    steps=items[0].steps, count=k,
+                    windows=(self.windows
+                             if self.executor.impl == "xla" else 1),
+                    donate=donate)
+                sm["cache_hit"] = self.executor.builds == builds0
         # analysis: ignore[broad-except] — dispatch supervisor: any
         # whole-batch failure must fan out to the affected tickets
         # instead of stranding them or leaking into an unrelated caller
@@ -698,11 +741,14 @@ class EnsembleScheduler:
         k = len(flight.items)
         c_f0 = self._clock()
         try:
-            results = complete_ensemble(
-                flight.inflight,
-                check_conservation=self.check_conservation,
-                tolerance=self.tolerance, rtol=self.rtol,
-                on_violation="mark")
+            with get_tracer().span(
+                    "ensemble.fetch", parent=flight.items[0].trace,
+                    **self._span_meta(flight.items, flight.bucket)):
+                results = complete_ensemble(
+                    flight.inflight,
+                    check_conservation=self.check_conservation,
+                    tolerance=self.tolerance, rtol=self.rtol,
+                    on_violation="mark")
         # analysis: ignore[broad-except] — dispatch supervisor: a fetch/
         # conservation-machinery failure fans out like a launch failure
         except Exception as e:
@@ -744,6 +790,10 @@ class EnsembleScheduler:
             # the outstanding span (launch start → fetched): the
             # occupancy numerator — under the async loop it covers the
             # overlap gap busy_s deliberately does not bill
+            # analysis: ignore[naked-timer] — the occupancy span
+            # (launch start -> fetched) closes against the launch
+            # anchor batch.py recorded; it feeds the inflight_s
+            # counter the spans are reconciled against
             inflight_s=time.perf_counter() - flight.inflight.t0)
         with self._lock:
             # a clean completion closes the health gate: the (possibly
@@ -874,6 +924,12 @@ class EnsembleScheduler:
             self._pending_tickets.discard(it.ticket)
         if not isinstance(res, Exception):
             self.counter.record_latency(self._clock() - it.submitted_at)
+            get_recorder().record("served", service_id=self.service_id,
+                                  ticket=it.ticket)
+        else:
+            get_recorder().record("failed", service_id=self.service_id,
+                                  ticket=it.ticket,
+                                  error=type(res).__name__)
 
     def _fanout_whole_error(self, items: list, bucket: int,
                             whole_err: Exception, cache_hit: bool,
@@ -980,6 +1036,14 @@ class EnsembleScheduler:
         self.counter.bump("quarantined")
         err.ticket = it.ticket
         err.failure_event = ev
+        # the flight recorder dumps beside every quarantine's
+        # FailureEvent (ISSUE 15): the ring holds what this service was
+        # doing in the run-up, which is the first post-mortem question
+        get_recorder().record("quarantined",
+                              service_id=self.service_id,
+                              ticket=it.ticket, fault_kind=kind)
+        get_recorder().dump("quarantine", service_id=self.service_id,
+                            ticket=it.ticket)
         self._publish(it, err)
 
     #: the degradation ladder: each impl's next-simpler engine. The

@@ -50,6 +50,7 @@ import warnings
 from typing import Callable, Optional, Sequence
 
 from ..core.cellular_space import CellularSpace
+from ..obs.flight import get_recorder
 from ..resilience import inject, lockdep
 from .scheduler import (DEFAULT_BUCKETS, EnsembleScheduler, TicketExpired,
                         TicketNotMigratable)
@@ -397,6 +398,8 @@ class AsyncEnsembleService:
             gated = sched.intake_gated and depth > 0
             if forced or gated or depth >= self.max_queue:
                 sched.counter.bump("shed")
+                get_recorder().record("shed", service_id=self.service_id,
+                                      depth=depth)
                 reason = (
                     "injected queue-full fault" if forced
                     else "intake health-gated (degradation ladder "
@@ -608,6 +611,11 @@ class AsyncEnsembleService:
                 e.failure_event = ev
                 sched.quarantine_log.append(ev)
                 sched.counter.bump("quarantined")
+                # the flight recorder dumps beside the HibernationError's
+                # FailureEvent (ISSUE 15) — no lock held here
+                get_recorder().dump("hibernation",
+                                    service_id=self.service_id,
+                                    ticket=ticket)
                 self._resolve_hibernated(ticket, e)
                 did += 1
                 continue
@@ -685,8 +693,15 @@ class AsyncEnsembleService:
         """Block until ``ticket`` resolves (the loop serves it);
         ``TimeoutError`` after ``timeout`` seconds. In manual mode
         (``start=False``) this pumps synchronously instead."""
-        deadline = (None if timeout is None
-                    else time.monotonic() + float(timeout))
+        # analysis: ignore[naked-timer] — result()'s timeout= is a
+        # CLIENT-facing wall bound, not a measurement (see the fleet
+        # twin); nothing is recorded
+        deadline = (
+            # analysis: ignore[naked-timer] — client wall bound (see
+            # the pragma block above), not a measurement
+            None if timeout is None
+            # analysis: ignore[naked-timer] — same bound
+            else time.monotonic() + float(timeout))
         while True:
             res = self.poll(ticket)
             if res is not None:
@@ -708,7 +723,10 @@ class AsyncEnsembleService:
                         "work — queue state is inconsistent")
                 continue
             with self._lock_cv:
+                # analysis: ignore[naked-timer] — the same client
+                # wall bound's expiry check
                 if (deadline is not None
+                        # analysis: ignore[naked-timer] — same bound
                         and time.monotonic() >= deadline):
                     raise TimeoutError(
                         f"ticket {ticket} still pending after "
@@ -821,7 +839,9 @@ class AsyncEnsembleService:
 
 def run_soak(service, scenarios, *, arrival_rate_hz: float,
              clock: Callable[[], float] = time.monotonic,
-             sleep: Callable[[float], None] = time.sleep) -> dict:
+             sleep: Callable[[float], None] = time.sleep,
+             snapshot_path: Optional[str] = None,
+             snapshot_interval_s: float = 5.0) -> dict:
     """Open-loop soak: submit ``scenarios`` (``(space, model, steps)``
     triples; model/steps may be None for the service defaults) at a
     fixed arrival rate — arrivals do NOT wait for completions, so a
@@ -834,12 +854,44 @@ def run_soak(service, scenarios, *, arrival_rate_hz: float,
     audit; ``ledger_complete`` says so).
 
     ``clock``/``sleep`` are injectable so tests drive the arrival
-    process without wall-clock sleeps; the bench uses real time."""
+    process without wall-clock sleeps; the bench uses real time.
+
+    ``snapshot_path`` (ISSUE 15): dump the unified telemetry-plane
+    snapshot (``obs.write_snapshot`` — atomic tmp+rename) there every
+    ``snapshot_interval_s`` of injectable-clock time during the soak,
+    and once at the end — bench rows, chaos tests and a human watching
+    the file all consume the SAME plane."""
     if arrival_rate_hz <= 0:
         raise ValueError(
             f"arrival_rate_hz={arrival_rate_hz} must be positive")
+
+    def dump_snapshot() -> None:
+        if snapshot_path is None:
+            return
+        from .. import obs
+
+        try:
+            obs.write_snapshot(snapshot_path, service)
+        except OSError as e:  # observability must not fail the soak
+            warnings.warn(f"telemetry snapshot write failed: {e}",
+                          RuntimeWarning)
+
     scenarios = list(scenarios)
     t0 = clock()
+    next_snap = t0 + float(snapshot_interval_s)
+
+    def maybe_dump(now: Optional[float] = None) -> None:
+        """The ONE interval-cadence owner: due-check + dump +
+        next_snap reset (four call sites — rate wait, post-wait,
+        drain, result slice — must never drift apart)."""
+        nonlocal next_snap
+        if snapshot_path is None:
+            return
+        if (clock() if now is None else now) < next_snap:
+            return
+        dump_snapshot()
+        next_snap = clock() + float(snapshot_interval_s)
+
     tickets: list = []
     shed = 0
     for i, (space, model, steps) in enumerate(scenarios):
@@ -848,7 +900,13 @@ def run_soak(service, scenarios, *, arrival_rate_hz: float,
             now = clock()
             if now >= due:
                 break
+            # the rate-wait is where a SLOW arrival process parks
+            # (20 s between tickets at 0.05 Hz): the interval dump
+            # must keep firing inside it or the --status file goes
+            # stale for the whole inter-arrival gap
+            maybe_dump(now)
             sleep(min(due - now, 0.01))
+        maybe_dump()
         try:
             tickets.append(service.submit(space, model=model, steps=steps))
         except ServiceOverloaded:
@@ -856,10 +914,29 @@ def run_soak(service, scenarios, *, arrival_rate_hz: float,
             tickets.append(None)
     served = failed = expired = 0
     for t in tickets:
+        # the drain phase is where a long soak spends its wall time
+        # (the default CLI invocation arrives at open throttle, so the
+        # arrival loop is over in microseconds): the interval dump must
+        # keep firing HERE or an operator watching the file sees
+        # nothing until the soak ends
+        maybe_dump()
         if t is None:
             continue
         try:
-            service.result(t)
+            if snapshot_path is None:
+                service.result(t)
+            else:
+                # one long-blocking result() must not freeze the
+                # --status file: wait in interval-sized slices and
+                # keep the cadence between them (the async service
+                # and the fleet both take result(timeout=))
+                while True:
+                    try:
+                        service.result(
+                            t, timeout=float(snapshot_interval_s))
+                        break
+                    except TimeoutError:
+                        maybe_dump()
             served += 1
         except TicketExpired:
             expired += 1
@@ -869,6 +946,7 @@ def run_soak(service, scenarios, *, arrival_rate_hz: float,
         except Exception:
             failed += 1
     wall = clock() - t0
+    dump_snapshot()  # the final cut: the plane at soak end
     st = service.stats()
     offered = len(scenarios)
     fleet_fields = (
@@ -880,6 +958,7 @@ def run_soak(service, scenarios, *, arrival_rate_hz: float,
         if "services" in st else {})
     return {
         **fleet_fields,
+        "telemetry_snapshot": snapshot_path,
         "offered": offered,
         "arrival_rate_hz": arrival_rate_hz,
         "served": served,

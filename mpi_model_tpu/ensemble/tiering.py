@@ -69,8 +69,10 @@ import numpy as np
 from ..core.cellular_space import CellularSpace
 from ..io.checkpoint import CheckpointCorruptionError
 from ..io.delta import DeltaChain
+from ..obs.flight import get_recorder
 from ..resilience import inject, lockdep
 from ..utils.metrics import ThroughputCounter
+from ..utils.tracing import get_tracer
 from .journal import TicketJournal, model_from_meta, model_meta, read_records
 
 __all__ = ["HibernationError", "HibernatedScenario", "ScenarioTiering",
@@ -286,7 +288,11 @@ class ScenarioTiering:
         in-memory state reference is the caller's to drop — after this
         returns, the chain + journal ARE the scenario."""
         nbytes = scenario_nbytes(space)
-        with self._lock:
+        # the hibernate span (ISSUE 15) parents under whatever context
+        # the caller attached (the ticket's submit span), so paging
+        # shows up inside the ticket's trace, not as orphan noise
+        with self._lock, get_tracer().span(
+                "tiering.hibernate", ticket=int(ticket)) as sm:
             if ticket in self._hibernated:
                 raise ValueError(f"ticket {ticket} is already hibernated")
             seq = self._next_seq.get(ticket, 0)
@@ -319,9 +325,13 @@ class ScenarioTiering:
             n = self._resident.pop(ticket, None)
             if n is not None:
                 self._resident_bytes -= n
+            sm["seq"] = seq
+            sm["rehibernation"] = rehib
         self.counter.bump("hibernations")
         if rehib:
             self.counter.bump("rehibernations")
+        get_recorder().record("hibernate", ticket=int(ticket),
+                              seq=seq, rehibernation=rehib)
         return entry
 
     def is_hibernated(self, ticket: int) -> bool:
@@ -357,8 +367,16 @@ class ScenarioTiering:
         on failure it stays for the caller to ``drop`` after publishing
         the error. Wall seconds of the materialization feed the
         wake-latency reservoir."""
+        # analysis: ignore[naked-timer] — the wake-latency reservoir's
+        # anchor: wake p50/p99 must stay REAL wall seconds even under
+        # a fake scheduler clock, and the reservoir (not a span
+        # rollup) is what stats()/bench publish
         t0 = time.perf_counter()
-        with self._lock:
+        # the wake-restore span (ISSUE 15): parents under the ticket's
+        # submit-span context (the fleet attaches it), so the restore
+        # cost is visible inside the ticket's own trace
+        with self._lock, get_tracer().span(
+                "tiering.wake", ticket=int(ticket)) as sm:
             e = self._hibernated.get(ticket)
             if e is None:
                 raise KeyError(f"ticket {ticket} is not hibernated")
@@ -406,8 +424,11 @@ class ScenarioTiering:
                 "ticket": int(ticket), "seq": e.seq, "source": source})
             self._hibernated.pop(ticket)
             self._hibernated_bytes -= e.disk_bytes
+            sm["source"] = source
         self.counter.bump("wakes")
+        # analysis: ignore[naked-timer] — closes the reservoir anchor
         self.counter.record_wake_latency(time.perf_counter() - t0)
+        get_recorder().record("wake", ticket=int(ticket), source=source)
         return space, e
 
     def requeue(self, ticket: int, entry: HibernatedScenario) -> None:

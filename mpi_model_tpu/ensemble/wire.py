@@ -69,6 +69,7 @@ __all__ = [
     "REQUEST_KINDS",
     "REPLY_KINDS",
     "MAX_FRAME_BYTES",
+    "TRACE_META_KEY",
 ]
 
 _MAGIC = b"TW1 "
@@ -85,6 +86,15 @@ REQUEST_KINDS = ("submit", "poll", "migrate", "queued", "pump", "drain",
                  "stats", "dispatch_log", "heartbeat", "shutdown")
 #: reply kinds (member → supervisor)
 REPLY_KINDS = ("ok", "pending", "overloaded", "err")
+
+#: the meta key a submit frame carries its trace context under
+#: (ISSUE 15): ``{"trace_id": ..., "span_id": ...}`` —
+#: ``utils.tracing.TraceContext.to_meta``. The member attaches it
+#: before admitting, so member-side dispatch spans parent under the
+#: fleet-side submit span ACROSS the process boundary; heartbeat
+#: replies ship the member's completed-span deltas back under
+#: ``telemetry["spans"]`` on the same frames.
+TRACE_META_KEY = "trace"
 
 
 class WireError(ValueError):
@@ -306,8 +316,15 @@ class FrameConn:
 
     def _recv(self, deadline_s: Optional[float]
               ) -> tuple[str, dict, Optional[dict]]:
-        t_end = (None if deadline_s is None
-                 else time.monotonic() + float(deadline_s))
+        # analysis: ignore[naked-timer] — socket-deadline arithmetic
+        # (settimeout needs remaining wall seconds), not timing: the
+        # RPC latency a span would measure lives in the client layer
+        t_end = (
+            # analysis: ignore[naked-timer] — socket-deadline
+            # arithmetic (see the pragma block above)
+            None if deadline_s is None
+            # analysis: ignore[naked-timer] — same bound
+            else time.monotonic() + float(deadline_s))
         header = self._read_exact(_HEADER_LEN, t_end)
         if header[:4] != _MAGIC or header[12:13] != b" " \
                 or header[21:22] != b"\n":
@@ -344,6 +361,8 @@ class FrameConn:
                 if self._closed:
                     raise WireClosed("connection already closed")
                 if t_end is not None:
+                    # analysis: ignore[naked-timer] — same deadline
+                    # arithmetic (remaining budget for settimeout)
                     remaining = t_end - time.monotonic()
                     if remaining <= 0:
                         raise WireTimeout(

@@ -1,0 +1,244 @@
+"""Unified telemetry plane (ISSUE 15 tentpole, part 2).
+
+Until this PR the stack's observability was scattered: supervisor
+counters in ``FleetSupervisor.stats()``, per-member cuts in heartbeat
+telemetry, tiering residency in ``ScenarioTiering.stats()``, tracer
+summaries on the process tracer, flight-recorder rings in
+``obs.flight`` — five surfaces, five shapes, and the bench/chaos tests
+each picked their own subset. This package merges them into ONE
+versioned JSON document:
+
+- :func:`fleet_snapshot` — the merged, schema-versioned snapshot
+  (``schema: "mpi-model-tpu.obs/1"``): serving stats (fleet- or
+  service-level, per-member breakdown included), tiering residency,
+  tracer per-stage rollups (with the explicit ``dropped`` count), and
+  the flight recorder's dump ledger. Humans, bench rows, the chaos
+  harness and the CLI ``--status`` flag all consume THIS document —
+  one plane, not per-consumer scrapes.
+- :func:`validate_snapshot` — the schema gate (the verify skill's
+  obs-smoke step and the tests call it; a field that silently vanishes
+  from the plane fails loudly here).
+- :func:`write_snapshot` — atomic dump-to-file (tmp + rename), the
+  shape ``run_soak(snapshot_path=...)`` emits on an interval during
+  soaks.
+- :func:`prometheus_text` — a Prometheus-style text exposition of
+  every ``ThroughputCounter`` counter (plus the latency/occupancy
+  gauges), per-member labeled ``{service_id="m<slot>g<gen>"}`` — for
+  scrape-based collection without teaching a collector our JSON.
+- :func:`timeline` (``obs.timeline``) — post-mortem per-ticket
+  timeline reconstruction joining the fleet journal, the tiering
+  lifecycle journal and exported span files, with EXPLICIT
+  gap/uncertainty records (never a silent hole); see
+  ``obs/postmortem.py``.
+- :mod:`obs.flight` — the flight recorder (bounded lifecycle-event
+  rings dumped beside every ``FailureEvent``).
+
+``python -m mpi_model_tpu.obs`` is the operator CLI over all of it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from .flight import FlightRecorder, get_recorder, set_recorder
+
+__all__ = [
+    "SCHEMA",
+    "FlightRecorder",
+    "fleet_snapshot",
+    "get_recorder",
+    "jsonable",
+    "prometheus_text",
+    "set_recorder",
+    "timeline",
+    "validate_snapshot",
+    "write_snapshot",
+]
+
+#: the telemetry-plane schema id; bump the suffix on any breaking
+#: field change so a consumer can dispatch on it
+SCHEMA = "mpi-model-tpu.obs/1"
+
+#: top-level fields every snapshot must carry (validate_snapshot)
+_REQUIRED = ("schema", "generated_unix_s", "stats", "tracer",
+             "flight_recorder")
+#: stats fields every serving snapshot must carry — the shared core of
+#: ThroughputCounter.snapshot() and FleetSupervisor.stats()
+_REQUIRED_STATS = ("dispatches", "scenarios", "busy_s", "inflight_s",
+                   "shed", "expired", "quarantined", "loop_faults",
+                   "latency_n", "latency_p50_s", "latency_p99_s")
+
+
+def fleet_snapshot(service=None, *, stats: Optional[dict] = None,
+                   tracer=None, recorder=None) -> dict:
+    """The unified telemetry plane as one versioned JSON document.
+
+    ``service`` is anything with a ``stats()`` method (an
+    ``AsyncEnsembleService``, a ``FleetSupervisor``, the sync
+    ``EnsembleService``); pass ``stats=`` instead when you already hold
+    a cut (the bench does — its cut and the snapshot's must be the
+    same one). Tiering residency and the per-member breakdown ride
+    inside ``stats`` already; the tracer contributes the per-stage
+    rollups and its ``dropped`` count; the flight recorder contributes
+    its dump ledger (reasons + counts, not the full rings — the rings
+    live in the dump files)."""
+    from ..utils.tracing import get_tracer
+
+    if stats is None:
+        if service is None:
+            raise ValueError(
+                "fleet_snapshot needs a service (anything with "
+                ".stats()) or an explicit stats= cut")
+        stats = service.stats()
+    tr = tracer if tracer is not None else get_tracer()
+    rec = recorder if recorder is not None else get_recorder()
+    dump_ledger = rec.dump_ledger()
+    summary = tr.summary()
+    meta = summary.pop("__tracer__", {"dropped": tr.dropped,
+                                      "recorded": len(tr.spans)})
+    return {
+        "schema": SCHEMA,
+        "generated_unix_s": time.time(),
+        "pid": os.getpid(),
+        "stats": _jsonable(stats),
+        "tracer": {
+            "dropped": meta.get("dropped", 0),
+            "recorded": meta.get("recorded", 0),
+            "stages": _jsonable(summary),
+        },
+        "flight_recorder": {
+            # copied under the recorder lock: the interval-dump thread
+            # snapshots while fence/quarantine threads append dumps
+            "dumps": len(dump_ledger),
+            "dump_reasons": sorted({d["reason"] for d in dump_ledger}),
+            "dump_paths": [d["path"] for d in dump_ledger
+                           if d.get("path")],
+        },
+    }
+
+
+def validate_snapshot(doc: dict) -> None:
+    """Raise ``ValueError`` naming the first missing/malformed field —
+    the schema gate of the plane (tests + the verify obs-smoke step).
+    Accepts any ``mpi-model-tpu.obs/1`` document."""
+    if not isinstance(doc, dict):
+        raise ValueError(f"snapshot is {type(doc).__name__}, not a dict")
+    for k in _REQUIRED:
+        if k not in doc:
+            raise ValueError(f"snapshot missing required field {k!r}")
+    if doc["schema"] != SCHEMA:
+        raise ValueError(
+            f"snapshot schema {doc['schema']!r} != expected {SCHEMA!r}")
+    stats = doc["stats"]
+    if not isinstance(stats, dict):
+        raise ValueError("snapshot stats is not a dict")
+    for k in _REQUIRED_STATS:
+        if k not in stats:
+            raise ValueError(f"snapshot stats missing field {k!r}")
+    tr = doc["tracer"]
+    if not isinstance(tr, dict) or "dropped" not in tr \
+            or "stages" not in tr:
+        raise ValueError(
+            "snapshot tracer block must carry dropped + stages — a "
+            "truncated trace must be explicit in the artifact")
+    json.dumps(doc)  # the plane is a JSON document, enforced
+
+
+def write_snapshot(path: str, service=None, **kw) -> dict:
+    """Snapshot to file, atomically (tmp + rename — a scraper reading
+    mid-write must never see a torn document). Returns the document."""
+    doc = fleet_snapshot(service, **kw)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, path)
+    return doc
+
+
+# -- Prometheus-style exposition ----------------------------------------------
+
+def _prom_name(key: str) -> str:
+    return "mpi_model_tpu_" + key.replace("-", "_")
+
+
+def _prom_lines(stats: dict, label: str = "") -> list:
+    out = []
+    for k in sorted(stats):
+        v = stats[k]
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        out.append((k, label, float(v)))
+    return out
+
+
+def prometheus_text(stats: dict) -> str:
+    """Prometheus text exposition of a serving stats cut: every numeric
+    counter/gauge as ``mpi_model_tpu_<name>``, with the per-member
+    breakdown (a fleet cut's ``services`` list) labeled by
+    ``service_id`` — counters whose names are in
+    ``ThroughputCounter.COUNTERS`` (plus dispatch/latency derivatives)
+    are typed ``counter``, everything else ``gauge``."""
+    from ..utils.metrics import ThroughputCounter
+
+    counterish = set(ThroughputCounter.COUNTERS) | {
+        "busy_s", "inflight_s", "compile_cache_hits"}
+    samples = _prom_lines(stats)
+    for m in stats.get("services") or ():
+        sid = m.get("service_id")
+        if sid is None:
+            continue
+        samples.extend(_prom_lines(
+            {k: v for k, v in m.items() if k != "service_id"},
+            label=f'{{service_id="{sid}"}}'))
+    by_name: dict = {}
+    for k, label, v in samples:
+        by_name.setdefault(k, []).append((label, v))
+    lines = []
+    for k in sorted(by_name):
+        kind = "counter" if k in counterish else "gauge"
+        name = _prom_name(k)
+        lines.append(f"# TYPE {name} {kind}")
+        for label, v in by_name[k]:
+            lines.append(f"{name}{label} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def jsonable(x):
+    """THE telemetry JSON projection (one implementation — the
+    heartbeat telemetry cuts in ``ensemble.member_proc`` and the
+    snapshot plane here must not drift): numpy scalars become numbers,
+    tuples become lists, anything else becomes its repr — telemetry
+    must never fail to serialize."""
+    if isinstance(x, dict):
+        return {str(k): jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [jsonable(v) for v in x]
+    if isinstance(x, (str, int, float, bool, type(None))):
+        return x
+    try:
+        import numpy as np
+
+        if isinstance(x, np.integer):
+            return int(x)
+        if isinstance(x, np.floating):
+            return float(x)
+        if isinstance(x, np.ndarray):
+            return x.tolist()
+    except ImportError:  # pragma: no cover - numpy is a hard dep
+        pass
+    return repr(x)
+
+
+_jsonable = jsonable  # the package-internal spelling
+
+
+def timeline(ticket: int, **kw):
+    """Post-mortem per-ticket timeline (``obs/postmortem.py`` has the
+    join semantics); re-exported here so ``obs.timeline(ticket,
+    journal_dir=...)`` is the one-call post-mortem entry point."""
+    from .postmortem import reconstruct
+
+    return reconstruct(ticket, **kw)

@@ -1,0 +1,89 @@
+"""``python -m mpi_model_tpu.obs`` — the operator CLI over the
+telemetry plane (ISSUE 15):
+
+- ``validate <snapshot.json>`` — schema-gate a dumped snapshot (exit 1
+  with the failing field named when it does not validate);
+- ``prom <snapshot.json>`` — render the snapshot's stats as the
+  Prometheus text exposition (scrape the dumped file without teaching
+  a collector our JSON);
+- ``timeline <ticket> --journal DIR [--vault DIR] [--trace FILE]`` —
+  reconstruct one ticket's lifecycle from the journals and an exported
+  Chrome trace; ``--json`` emits the timeline document, otherwise a
+  human-ordered listing. Exit 1 when the timeline is INCOMPLETE
+  (no submit, or no/duplicate terminal) — the post-mortem acceptance
+  predicate, scriptable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from . import prometheus_text, validate_snapshot
+from .postmortem import reconstruct
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m mpi_model_tpu.obs",
+        description="Telemetry-plane CLI: snapshot validation, "
+                    "Prometheus exposition, per-ticket timeline "
+                    "reconstruction.")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    v = sub.add_parser("validate", help="schema-gate a snapshot file")
+    v.add_argument("snapshot")
+
+    pr = sub.add_parser("prom", help="Prometheus text exposition of a "
+                                     "snapshot's stats")
+    pr.add_argument("snapshot")
+
+    t = sub.add_parser("timeline", help="reconstruct one ticket's "
+                                        "lifecycle")
+    t.add_argument("ticket", type=int)
+    t.add_argument("--journal", required=True,
+                   help="fleet journal directory")
+    t.add_argument("--vault", default=None,
+                   help="tiering vault directory (hibernation journal)")
+    t.add_argument("--trace", default=None,
+                   help="exported Chrome trace (export_chrome output)")
+    t.add_argument("--json", action="store_true")
+
+    args = p.parse_args(argv)
+    if args.cmd == "validate":
+        with open(args.snapshot) as fh:
+            doc = json.load(fh)
+        try:
+            validate_snapshot(doc)
+        except ValueError as e:
+            print(f"INVALID: {e}", file=sys.stderr)
+            return 1
+        print(f"ok: {args.snapshot} validates against {doc['schema']}")
+        return 0
+    if args.cmd == "prom":
+        with open(args.snapshot) as fh:
+            doc = json.load(fh)
+        sys.stdout.write(prometheus_text(doc.get("stats", {})))
+        return 0
+    # timeline
+    tl = reconstruct(args.ticket, journal_dir=args.journal,
+                     vault_dir=args.vault, spans=args.trace)
+    if args.json:
+        print(json.dumps(tl.to_dict(), sort_keys=True))
+    else:
+        for e in tl.events:
+            ts = "              " if e.t_wall is None \
+                else f"{e.t_wall:14.3f}"
+            sid = "" if e.service_id is None else f" [{e.service_id}]"
+            print(f"{ts} {e.source:<14} {e.kind:<18}{sid} {e.detail}")
+        print(f"-- ticket {tl.ticket}: "
+              + ("COMPLETE" if tl.complete else "INCOMPLETE")
+              + (f", {len(tl.gaps)} explicit gap/uncertainty record(s)"
+                 if tl.gaps else ", gap-free"))
+    return 0 if tl.complete else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,161 @@
+"""Flight recorder — a bounded ring of recent ticket-lifecycle events
+per service (ISSUE 15 tentpole, part 3).
+
+The journals are the durable ledger, but they are append-only files
+tuned for replay, and the tracer ring is duration-shaped. When a fence,
+a quarantine or a ``HibernationError`` fires, the question an operator
+asks first is "what was this service doing in the seconds before?" —
+the flight recorder answers it: every lifecycle seam (submit, dispatch,
+served, quarantined, expired, shed, hibernate, wake, fence, respawn)
+drops one tiny event into a per-service ring, and any failure worth a
+``FailureEvent`` DUMPS the ring alongside it (``dumps`` in memory,
+JSON files when the recorder was built with ``dump_dir=`` — the CLI's
+``--status PATH`` installs one dumping under ``PATH.flight.d/``), so
+the post-mortem starts with the recent history already cut out.
+
+Design constraints, in order:
+
+- **cheap enough to leave on**: one dict, one deque append, one leaf
+  lock — no I/O on the record path (I/O happens only at dump time,
+  which is already a failure path);
+- **bounded everywhere**: per-service rings hold ``capacity`` events,
+  the in-memory dump list holds ``max_dumps`` dumps, dump files are
+  ring-sized;
+- **process-wide default** (``get_recorder``/``set_recorder``, the
+  ``get_tracer`` pattern): the serving stack records into it without
+  plumbing a handle through every constructor; tests swap a fresh one.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = [
+    "FlightRecorder",
+    "get_recorder",
+    "set_recorder",
+]
+
+#: events kept per service ring
+DEFAULT_CAPACITY = 256
+#: in-memory dumps kept (oldest discarded — a failure storm must not
+#: grow memory without bound either)
+DEFAULT_MAX_DUMPS = 32
+
+
+class FlightRecorder:
+    """Bounded per-service ring of lifecycle events + failure dumps
+    (module docstring). Thread-safe behind one leaf lock (nothing is
+    ever acquired under it — the serving stack records from under its
+    own locks, so this one must stay a leaf)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 max_dumps: int = DEFAULT_MAX_DUMPS,
+                 dump_dir: Optional[str] = None):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._rings: dict[str, collections.deque] = {}
+        #: most recent failure dumps: {reason, service_id, t_wall,
+        #: events, path} — newest last
+        self.dumps: collections.deque = collections.deque(
+            maxlen=int(max_dumps))
+        self.dump_dir = dump_dir
+        self._dump_seq = 0
+
+    # -- recording -----------------------------------------------------------
+
+    def record(self, kind: str, *, service_id: Optional[str] = None,
+               ticket: Optional[int] = None, **detail) -> None:
+        """One lifecycle event into ``service_id``'s ring (None lands
+        in the ``"fleet"`` ring). ``t_wall`` is stamped here so dumped
+        rings order against journal records and spans."""
+        ev = {"t_wall": time.time(), "kind": kind,
+              "service_id": service_id, "ticket": ticket}
+        if detail:
+            ev.update(detail)
+        key = service_id or "fleet"
+        with self._lock:
+            ring = self._rings.get(key)
+            if ring is None:
+                ring = collections.deque(maxlen=self.capacity)
+                self._rings[key] = ring
+            ring.append(ev)
+
+    # -- dumping -------------------------------------------------------------
+
+    def snapshot(self, service_id: Optional[str] = None) -> list:
+        """The ring's current events (all rings merged by time when
+        ``service_id`` is None) — newest last."""
+        with self._lock:
+            if service_id is not None:
+                return list(self._rings.get(service_id, ()))
+            merged: list = []
+            for ring in self._rings.values():
+                merged.extend(ring)
+        merged.sort(key=lambda e: e["t_wall"])
+        return merged
+
+    def dump_ledger(self) -> list:
+        """The current dump records, copied under the lock — iterating
+        ``dumps`` directly races a concurrent failure's append (deque
+        mutation during iteration raises)."""
+        with self._lock:
+            return list(self.dumps)
+
+    def dump(self, reason: str, *, service_id: Optional[str] = None,
+             ticket: Optional[int] = None) -> dict:
+        """Cut the recent history out NOW (a fence, a quarantine, a
+        ``HibernationError`` — anything that also lands a
+        ``FailureEvent``): the affected service's ring plus the fleet
+        ring, kept in ``dumps`` and written to ``dump_dir`` when
+        configured. Returns the dump record."""
+        events = self.snapshot(service_id)
+        if service_id is not None:
+            fleet = self.snapshot("fleet")
+            events = sorted(events + fleet, key=lambda e: e["t_wall"])
+        rec = {"reason": reason, "service_id": service_id,
+               "ticket": ticket, "t_wall": time.time(),
+               "events": events, "path": None}
+        with self._lock:
+            self._dump_seq += 1
+            seq = self._dump_seq
+        if self.dump_dir is not None:
+            try:
+                os.makedirs(self.dump_dir, exist_ok=True)
+                path = os.path.join(
+                    self.dump_dir, f"flight-{seq:04d}-{reason}.json")
+                with open(path, "w") as fh:
+                    json.dump(rec, fh)
+                rec["path"] = path
+            except OSError:
+                # the dump is best-effort observability on a path that
+                # is ALREADY failing — never let it cascade
+                rec["path"] = None
+        with self._lock:
+            self.dumps.append(rec)
+        return rec
+
+
+# -- process-wide default recorder -------------------------------------------
+
+_default = FlightRecorder()
+_default_lock = threading.Lock()
+
+
+def get_recorder() -> FlightRecorder:
+    return _default
+
+
+def set_recorder(recorder: FlightRecorder) -> FlightRecorder:
+    """Swap the process-wide recorder (tests install a fresh one;
+    ``--status`` serve runs install one with a dump dir); returns the
+    previous."""
+    global _default
+    with _default_lock:
+        prev, _default = _default, recorder
+    return prev

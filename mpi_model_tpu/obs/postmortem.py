@@ -1,0 +1,296 @@
+"""Post-mortem timeline reconstruction (ISSUE 15 tentpole, part 3).
+
+"What happened to ticket X during the kill?" is the question every
+production incident starts with, and before this PR the answer was
+spread over four artifacts in three formats: the fleet ticket journal,
+the tiering lifecycle journal, the tracer's span ring (or an exported
+Chrome trace) and the flight-recorder dumps. :func:`reconstruct` joins
+them into ONE ordered per-ticket timeline:
+
+- **fleet journal** (``ensemble.journal``): submit / served /
+  quarantined / expired / readmit / migrate / wake records for the
+  ticket, in verified-record order (each stamped ``t_wall`` since this
+  PR; older journals order by record index alone and say so);
+- **tiering journal** (``<vault>/hibernation.journal``): hibernate /
+  hibernated / wake / requeue / reclaim lifecycle records;
+- **spans**: dicts from ``Tracer.spans``/``ingest`` or a Chrome trace
+  file (``export_chrome``) — matched by the ticket's ``trace_id``
+  (carried in its journal submit record) or by ticket membership in a
+  dispatch span's ``tickets``/``trace_ids`` meta;
+- **explicit uncertainty**: a submitted-but-unresolved ticket gets a
+  synthesized ``uncertainty`` event ("in flight on m2g1 at end of
+  journal — process killed?"), and a readmit after a fence closes the
+  gap with the handoff visible. A timeline NEVER has a silent hole:
+  what is not known is a record saying it is not known.
+
+``Timeline.complete`` is the acceptance predicate the chaos kill legs
+assert: submit + exactly one terminal, with any submit→terminal gap
+either covered by records or explicitly annotated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+__all__ = ["Timeline", "TimelineEvent", "reconstruct", "spans_from_chrome"]
+
+#: journal kinds that terminate a ticket (mirrors journal.TERMINAL_KINDS
+#: without importing it at module load — obs must stay import-light)
+_TERMINAL = ("served", "quarantined", "expired")
+
+
+@dataclasses.dataclass
+class TimelineEvent:
+    """One timeline entry. ``t_wall`` is None for records from sources
+    without a wall stamp (pre-ISSUE-15 journals) — such events keep
+    their source order and the timeline says the ordering is by index,
+    not by clock."""
+
+    t_wall: Optional[float]
+    source: str  # "journal" | "tiering" | "span" | "reconstruction"
+    kind: str
+    detail: str
+    service_id: Optional[str] = None
+    #: source-local ordering key (journal record index / span start)
+    order: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Timeline:
+    """One ticket's reconstructed lifecycle."""
+
+    ticket: int
+    events: list
+    #: submit seen + exactly one terminal record seen
+    complete: bool
+    #: the explicit uncertainty/gap annotations (also present in
+    #: ``events`` — listed separately so "no silent gaps" is checkable)
+    gaps: list
+    #: the trace id the ticket's spans were matched by (None when the
+    #: submit record carried no trace context)
+    trace_id: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "ticket": self.ticket,
+            "complete": self.complete,
+            "trace_id": self.trace_id,
+            "events": [e.to_dict() for e in self.events],
+            "gaps": [e.to_dict() for e in self.gaps],
+        }
+
+
+def spans_from_chrome(path: str) -> list:
+    """Span dicts out of an ``export_chrome`` artifact — the offline
+    counterpart of ``Tracer.spans`` for post-mortem joins."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    out = []
+    for e in doc.get("traceEvents", ()):
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        out.append({
+            "name": e.get("name"),
+            "start_wall_s": e.get("ts", 0.0) / 1e6,
+            "duration_s": e.get("dur", 0.0) / 1e6,
+            "pid": e.get("pid"), "thread": e.get("tid"),
+            "meta": {k: v for k, v in args.items()
+                     if k not in ("trace_id", "span_id", "parent_id")},
+            "trace_id": args.get("trace_id"),
+            "span_id": args.get("span_id"),
+            "parent_id": args.get("parent_id"),
+        })
+    return out
+
+
+def _span_dicts(spans) -> list:
+    """Normalize ``Tracer.spans`` (Span objects) / dict lists / a
+    chrome-trace path into plain span dicts."""
+    if spans is None:
+        return []
+    if isinstance(spans, str):
+        return spans_from_chrome(spans)
+    out = []
+    for s in spans:
+        out.append(s if isinstance(s, dict) else s.to_dict())
+    return out
+
+
+#: stat-signature read cache (the tiering journal-fallback pattern):
+#: reconstructing N tickets' timelines over the same pair of journal
+#: files must scan + CRC each file once, not once per ticket. Bounded
+#: at a few entries (fleet journal + tiering journal alternate within
+#: one reconstruct() call — a single slot would thrash).
+_READ_CACHE: dict = {}
+_READ_CACHE_MAX = 4
+
+
+def _read_records_cached(path: str):
+    from ..ensemble.journal import read_records
+
+    st = os.stat(path)
+    sig = (st.st_mtime_ns, st.st_size)
+    hit = _READ_CACHE.get(path)
+    if hit is not None and hit[0] == sig:
+        return hit[1], hit[2]
+    records, torn = read_records(path)
+    while len(_READ_CACHE) >= _READ_CACHE_MAX:
+        _READ_CACHE.pop(next(iter(_READ_CACHE)))
+    _READ_CACHE[path] = (sig, records, torn)
+    return records, torn
+
+
+def _journal_events(ticket: int, path: str, source: str) -> tuple:
+    """(events, submit_meta, terminal_kinds) for ``ticket`` from one
+    TJ1 journal file."""
+    events: list = []
+    submit_meta: Optional[dict] = None
+    terminals: list = []
+    if not os.path.exists(path):
+        return events, submit_meta, terminals
+    records, torn = _read_records_cached(path)
+    for rec in records:
+        if rec.meta.get("ticket") != ticket:
+            continue
+        sid = rec.meta.get("service_id")
+        bits = []
+        for k in ("seq", "source", "from", "to", "reason", "error",
+                  "detail", "steps"):
+            v = rec.meta.get(k)
+            if v is not None:
+                bits.append(f"{k}={v}")
+        events.append(TimelineEvent(
+            t_wall=rec.meta.get("t_wall"), source=source, kind=rec.kind,
+            detail="; ".join(bits), service_id=sid, order=rec.index))
+        if rec.kind == "submit" and submit_meta is None:
+            submit_meta = rec.meta
+        if rec.kind in _TERMINAL:
+            terminals.append(rec.kind)
+    if torn:
+        events.append(TimelineEvent(
+            t_wall=None, source=source, kind="journal-torn-tail",
+            detail=f"{path} had an unverifiable suffix — events after "
+                   "the verified prefix are unknown",
+            order=len(records) + 0.5))
+    return events, submit_meta, terminals
+
+
+def reconstruct(ticket: int, *, journal_dir: Optional[str] = None,
+                vault_dir: Optional[str] = None,
+                spans=None) -> Timeline:
+    """Join every available source into one ordered timeline for
+    ``ticket`` (module docstring has the semantics). ``spans`` accepts
+    ``Tracer.spans``, a list of span dicts, or a Chrome-trace path."""
+    from ..ensemble.journal import journal_path
+    from ..ensemble.tiering import HIBERNATE_JOURNAL
+
+    events: list = []
+    gaps: list = []
+    submit_meta = None
+    terminals: list = []
+    if journal_dir is not None:
+        ev, submit_meta, terminals = _journal_events(
+            ticket, journal_path(journal_dir), "journal")
+        events.extend(ev)
+    if vault_dir is not None:
+        ev, _, _ = _journal_events(
+            ticket, os.path.join(vault_dir, HIBERNATE_JOURNAL), "tiering")
+        events.extend(ev)
+
+    # span join: by the submit record's trace id, or by ticket
+    # membership in a dispatch span's meta
+    trace_id = None
+    if submit_meta is not None:
+        tmeta = submit_meta.get("trace")
+        if isinstance(tmeta, dict):
+            trace_id = tmeta.get("trace_id")
+    for d in _span_dicts(spans):
+        meta = d.get("meta") or {}
+        tid = d.get("trace_id")
+        if trace_id is not None:
+            # the journaled trace id is authoritative: dispatch-span
+            # `tickets` are MEMBER-LOCAL scheduler ids in a fleet (a
+            # fleet ticket 5 and some member's ticket 5 are unrelated
+            # scenarios), so raw ticket-membership must not join here
+            match = (tid == trace_id
+                     or trace_id in (meta.get("trace_ids") or ()))
+        else:
+            # no journaled trace (pre-ISSUE-15 journal, or no journal
+            # at all): fall back to ticket membership — correct only
+            # for a SINGLE-scheduler namespace, which is exactly the
+            # no-fleet case this branch serves
+            match = (ticket in (meta.get("tickets") or ())
+                     or meta.get("ticket") == ticket)
+        if not match:
+            continue
+        t0 = d.get("start_wall_s")
+        events.append(TimelineEvent(
+            t_wall=t0, source="span", kind=d.get("name", "span"),
+            detail=f"{d.get('duration_s', 0.0):.6f}s "
+                   f"pid={d.get('pid')}",
+            service_id=meta.get("service_id"),
+            order=t0 if t0 is not None else 0.0))
+
+    # explicit uncertainty: submitted, never resolved → say so, naming
+    # where it was last known to be (the last attribution record wins)
+    if submit_meta is not None and not terminals:
+        last_sid = submit_meta.get("service_id")
+        for e in events:
+            if e.source == "journal" and e.kind in ("readmit", "migrate",
+                                                    "wake"):
+                last_sid = e.service_id or last_sid
+                # readmit/migrate/wake meta carries to= in the detail;
+                # the service_id field is what we surface
+        where = (f"on {last_sid}" if last_sid else "unattributed")
+        gap = TimelineEvent(
+            t_wall=None, source="reconstruction", kind="uncertainty",
+            detail=f"submitted but never resolved in the journal — in "
+                   f"flight {where} at end of journal (process killed "
+                   "before a terminal record, or the journal's tail "
+                   "was lost)",
+            service_id=last_sid, order=float("inf"))
+        events.append(gap)
+        gaps.append(gap)
+    if submit_meta is None and (journal_dir is not None or events):
+        gap = TimelineEvent(
+            t_wall=None, source="reconstruction", kind="uncertainty",
+            detail="no verified submit record for this ticket — the "
+                   "journal predates it, lost its tail, or the ticket "
+                   "id is from another fleet",
+            order=float("-inf"))
+        events.append(gap)
+        gaps.append(gap)
+    if any(e.t_wall is None and e.source in ("journal", "tiering")
+           for e in events):
+        note = TimelineEvent(
+            t_wall=None, source="reconstruction", kind="ordering-note",
+            detail="some records carry no t_wall stamp (pre-ISSUE-15 "
+                   "journal) — their order is record-index order, not "
+                   "clock order",
+            order=float("-inf"))
+        events.append(note)
+
+    # merge order: wall time when present; unstamped events keep their
+    # source-local order interleaved after the last stamped event
+    # before them (stable sort on (t_wall or +inf bucket, order))
+    def sort_key(e: TimelineEvent):
+        return (e.t_wall if e.t_wall is not None else float("inf"),
+                e.order)
+
+    stamped = sorted((e for e in events if e.t_wall is not None),
+                     key=sort_key)
+    unstamped = sorted((e for e in events if e.t_wall is None),
+                       key=lambda e: e.order)
+    return Timeline(
+        ticket=ticket,
+        events=stamped + unstamped,
+        complete=(submit_meta is not None and len(terminals) == 1),
+        gaps=gaps,
+        trace_id=trace_id)

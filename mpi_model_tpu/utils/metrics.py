@@ -203,6 +203,56 @@ def median_spread(samples: list[float]) -> dict:
 LATENCY_RESERVOIR = 65536
 
 
+class LatencyReservoir:
+    """THE bounded percentile reservoir (ISSUE 15 satellite): one
+    implementation behind the queue-latency and wake-latency fields
+    that used to be two copy-pasted deque+sort blocks, and behind the
+    tracer's per-stage span rollups.
+
+    Bounded (the most recent ``maxlen`` samples — what an operator
+    watching a live service wants anyway) and locked with its own LEAF
+    lock (a plain ``threading.Lock``; nothing is ever acquired under
+    it, and callers holding their own locks read percentiles BEFORE
+    taking them, so the reservoir adds no acquisition-graph edges)."""
+
+    def __init__(self, maxlen: int = LATENCY_RESERVOIR):
+        import threading
+
+        self._lock = threading.Lock()
+        self._samples: collections.deque = collections.deque(
+            maxlen=int(maxlen))
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._samples.append(float(seconds))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    @staticmethod
+    def percentile_of(sorted_samples: list, q: float):
+        """Nearest-rank percentile over an already-sorted list (None
+        when empty) — the one percentile definition every p50/p99 the
+        package publishes shares."""
+        if not sorted_samples:
+            return None
+        i = min(int(round(q * (len(sorted_samples) - 1))),
+                len(sorted_samples) - 1)
+        return sorted_samples[i]
+
+    def snapshot(self, prefix: str = "latency") -> dict:
+        """One consistent percentile cut:
+        ``{<prefix>_n, <prefix>_p50_s, <prefix>_p99_s}``."""
+        with self._lock:
+            samples = sorted(self._samples)
+        return {
+            f"{prefix}_n": len(samples),
+            f"{prefix}_p50_s": self.percentile_of(samples, 0.50),
+            f"{prefix}_p99_s": self.percentile_of(samples, 0.99),
+        }
+
+
 class ThroughputCounter:
     """Monotonic serving counters for the ensemble engine (scheduler /
     service): scenarios served, dispatches, dispatched lanes (incl.
@@ -305,13 +355,13 @@ class ThroughputCounter:
         self.rehibernations = 0
         self.wakes = 0
         self.wake_faults = 0
-        self._latencies: collections.deque = collections.deque(
-            maxlen=LATENCY_RESERVOIR)
-        #: wall seconds each wake spent materializing its scenario
-        #: (chain restore + resubmit) — the paging cost a client
-        #: actually observes; bounded like the queue-latency reservoir
-        self._wake_latencies: collections.deque = collections.deque(
-            maxlen=LATENCY_RESERVOIR)
+        #: the queue-latency and wake-latency reservoirs share ONE
+        #: implementation (ISSUE 15 satellite): bounded, self-locked
+        #: LatencyReservoir — wake latency is the wall seconds each
+        #: wake spent materializing its scenario (chain restore +
+        #: resubmit), the paging cost a client actually observes
+        self._latencies = LatencyReservoir()
+        self._wake_latencies = LatencyReservoir()
 
     def record_dispatch(self, scenarios: int, bucket: int, wall_s: float,
                         cache_hit: bool,
@@ -346,28 +396,26 @@ class ThroughputCounter:
 
     def record_latency(self, seconds: float) -> None:
         """One served scenario's submit-to-served latency (scheduler
-        clock), feeding the p50/p99 snapshot fields."""
-        with self._lock:
-            self._latencies.append(float(seconds))
+        clock), feeding the p50/p99 snapshot fields. The reservoir
+        carries its own leaf lock — the counter lock is not taken."""
+        self._latencies.record(seconds)
 
     def record_wake_latency(self, seconds: float) -> None:
         """One wake's wall seconds (hibernation-chain restore through
         resubmission — ``time.perf_counter`` spans, real even under a
         fake scheduler clock), feeding the ``wake_latency_p50_s``/
         ``wake_latency_p99_s`` snapshot fields."""
-        with self._lock:
-            self._wake_latencies.append(float(seconds))
-
-    @staticmethod
-    def _percentile(sorted_samples: list, q: float) -> float:
-        i = min(int(round(q * (len(sorted_samples) - 1))),
-                len(sorted_samples) - 1)
-        return sorted_samples[i]
+        self._wake_latencies.record(seconds)
 
     def snapshot(self) -> dict:
+        # percentile cuts are read BEFORE the counter lock: the
+        # reservoirs are their own (leaf-) locked objects, so taking
+        # them under the counter lock would add an acquisition edge
+        # for no atomicity gain (a latency sample racing a counter
+        # bump was never one transaction to begin with)
+        lat = self._latencies.snapshot("latency")
+        wlat = self._wake_latencies.snapshot("wake_latency")
         with self._lock:
-            lat = sorted(self._latencies)
-            wlat = sorted(self._wake_latencies)
             return {
                 "dispatches": self.dispatches,
                 "scenarios": self.scenarios,
@@ -399,16 +447,8 @@ class ThroughputCounter:
                 "rehibernations": self.rehibernations,
                 "wakes": self.wakes,
                 "wake_faults": self.wake_faults,
-                "latency_n": len(lat),
-                "latency_p50_s": (self._percentile(lat, 0.50)
-                                  if lat else None),
-                "latency_p99_s": (self._percentile(lat, 0.99)
-                                  if lat else None),
-                "wake_latency_n": len(wlat),
-                "wake_latency_p50_s": (self._percentile(wlat, 0.50)
-                                       if wlat else None),
-                "wake_latency_p99_s": (self._percentile(wlat, 0.99)
-                                       if wlat else None),
+                **lat,
+                **wlat,
             }
 
 

@@ -7,19 +7,39 @@ speed"). Here tracing is structured and first-class:
 
 - ``Tracer.span(name)`` — nested, thread-safe wall-clock spans with
   per-thread nesting (one span stack per thread, like a profiler);
-- ``summary()`` — per-name aggregates (count / total / mean / max);
+- **trace context propagation** (ISSUE 15): every span carries a
+  ``trace_id``/``span_id``/``parent_id``; nesting parents automatically
+  through a per-thread context stack, ``span(parent=ctx)`` parents
+  explicitly (a dispatch span under its ticket's submit span), and
+  ``attach(ctx)`` adopts a context that crossed a PROCESS boundary (the
+  fleet wire carries ``TraceContext.to_meta()`` in the submit frame, so
+  member-side spans parent under the fleet-side submit span);
+- ``summary()`` — per-name aggregates (count / total / mean / max /
+  p50 / p99 via the shared ``metrics.LatencyReservoir`` percentile
+  machinery) plus an explicit ``__tracer__`` entry carrying ``dropped``
+  — a truncated trace says so in the artifact, not just on the object;
 - ``export_chrome()`` — the Chrome trace-event format, loadable in
   ``chrome://tracing`` / Perfetto alongside XLA's own device traces;
+  multi-process merges are labeled via ``process_name`` metadata
+  records (``label_process`` — the fleet stamps members m<slot>g<gen>)
+  and the export carries a top-level ``dropped`` count;
+- ``ingest()`` / ``spans_since()`` — the heartbeat shipping lane:
+  a member exports its completed-span deltas as plain dicts
+  (wall-clock-anchored, so merged timelines order across processes)
+  and the supervisor absorbs them into its own ring;
 - ``device_trace()`` — wraps ``jax.profiler.trace`` so host spans and
   the XLA/TPU device profile are captured over the same window (this is
   how BASELINE's halo-exchange share is attributed on real hardware);
 - a process-wide default tracer (``get_tracer``/``trace_span``) that the
   framework's own phases report into: ``Model.execute`` emits
   ``model.execute`` / ``executor.run``, the sharded executors emit their
-  build-vs-run phases.
+  build-vs-run phases, the serving stack emits per-dispatch
+  assemble/launch/fetch and per-wake spans.
 
-Recording one span is two ``perf_counter`` calls and a list append —
-cheap enough to leave on; ``Tracer(enabled=False)`` makes it free.
+Recording one span is two ``perf_counter`` calls, two id formats and a
+list append — cheap enough to leave on (the bench's
+``tracing_overhead_frac`` field gates the claim with a measured
+number); ``Tracer(enabled=False)`` makes it free.
 """
 
 from __future__ import annotations
@@ -27,24 +47,64 @@ from __future__ import annotations
 import collections
 import contextlib
 import dataclasses
+import itertools
 import json
+import os
 import threading
 import time
 from typing import Any, Iterator, Optional
 
 __all__ = [
     "Span",
+    "TraceContext",
     "Tracer",
     "get_tracer",
     "set_tracer",
     "trace_span",
 ]
 
+#: process-unique span-id source: ids are ``<pid:x>-<n:x>`` so two
+#: processes (a fleet and its spawned members) can never collide —
+#: no randomness needed, and ids stay stable/debuggable
+_ids = itertools.count(1)
+
+
+def _new_id() -> str:
+    return f"{os.getpid():x}-{next(_ids):x}"
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One position in a trace: the trace it belongs to and the span
+    that is the current parent. Immutable; crosses thread and process
+    boundaries as a two-key dict (``to_meta``/``from_meta`` — the TW1
+    wire frames and the journal submit records carry exactly this)."""
+
+    trace_id: str
+    span_id: str
+
+    def to_meta(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @classmethod
+    def from_meta(cls, meta: Optional[dict]) -> Optional["TraceContext"]:
+        """None-safe decode — a frame without trace meta propagates
+        nothing (spans then root locally), it never errors."""
+        if not isinstance(meta, dict):
+            return None
+        t, s = meta.get("trace_id"), meta.get("span_id")
+        if not (isinstance(t, str) and isinstance(s, str)):
+            return None
+        return cls(t, s)
+
 
 @dataclasses.dataclass
 class Span:
     """One completed span. ``start_s`` is ``perf_counter``-based and only
-    meaningful relative to other spans from the same tracer."""
+    meaningful relative to other spans from the same tracer;
+    ``start_wall_s`` is the wall-clock anchor (``time.time`` epoch
+    seconds) that lets spans from DIFFERENT processes merge into one
+    ordered timeline."""
 
     name: str
     start_s: float
@@ -52,10 +112,44 @@ class Span:
     thread: int
     depth: int
     meta: dict[str, Any] = dataclasses.field(default_factory=dict)
+    #: trace-context identity (ISSUE 15); None on spans recorded by a
+    #: pre-context tracer dict (ingest tolerates their absence)
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+    parent_id: Optional[str] = None
+    #: wall-clock anchor of start_s (epoch seconds)
+    start_wall_s: Optional[float] = None
+    #: recording process (spans ingested from a member keep theirs)
+    pid: int = 0
+    #: monotone per-tracer append index — the heartbeat delta cursor
+    seq: int = 0
+
+    def to_dict(self) -> dict:
+        """The wire/export projection (plain JSON-able dict)."""
+        return {
+            "name": self.name, "start_s": self.start_s,
+            "duration_s": self.duration_s, "thread": self.thread,
+            "depth": self.depth, "meta": dict(self.meta),
+            "trace_id": self.trace_id, "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_wall_s": self.start_wall_s, "pid": self.pid,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Span":
+        return cls(
+            name=d.get("name", "?"), start_s=float(d.get("start_s", 0.0)),
+            duration_s=float(d.get("duration_s", 0.0)),
+            thread=int(d.get("thread", 0)), depth=int(d.get("depth", 0)),
+            meta=dict(d.get("meta") or {}), trace_id=d.get("trace_id"),
+            span_id=d.get("span_id"), parent_id=d.get("parent_id"),
+            start_wall_s=d.get("start_wall_s"),
+            pid=int(d.get("pid", 0)))
 
 
 class Tracer:
-    """Thread-safe span recorder with per-thread nesting.
+    """Thread-safe span recorder with per-thread nesting and trace
+    contexts.
 
     The buffer is a ring of at most ``max_spans`` (oldest dropped first,
     ``dropped`` counts them) so the always-on default tracer stays
@@ -68,46 +162,158 @@ class Tracer:
         self.dropped = 0
         self._lock = threading.Lock()
         self._local = threading.local()
+        self._pid = os.getpid()
+        #: wall-clock anchor: start_wall_s = start_s + _wall_off (one
+        #: pair of clock reads at construction, not two reads per span)
+        self._wall_off = time.time() - time.perf_counter()
+        self._seq = 0
+        #: pid → human label for export_chrome's process_name metadata
+        #: (the fleet labels members m<slot>g<gen> at heartbeat ingest)
+        self._process_labels: dict[int, str] = {}
+
+    # -- trace context ------------------------------------------------------
+
+    def _ctx_stack(self) -> list:
+        s = getattr(self._local, "ctx", None)
+        if s is None:
+            s = []
+            # analysis: ignore[unguarded-shared-mutation] — threading.local
+            # storage: each thread mutates only its own context stack
+            self._local.ctx = s
+        return s
+
+    def current(self) -> Optional[TraceContext]:
+        """The calling thread's innermost open context (a span in
+        progress, or an ``attach``-ed remote parent), or None."""
+        s = self._ctx_stack()
+        return s[-1] if s else None
+
+    @contextlib.contextmanager
+    def attach(self, ctx: Optional[TraceContext]) -> Iterator[None]:
+        """Adopt a context from elsewhere (another thread or — via the
+        wire's ``trace`` meta — another process) as the calling
+        thread's current parent, for the duration of the block. A None
+        context is a no-op, so call sites need no branching."""
+        if ctx is None:
+            yield
+            return
+        stack = self._ctx_stack()
+        stack.append(ctx)
+        try:
+            yield
+        finally:
+            stack.pop()
 
     # -- recording ----------------------------------------------------------
 
     @contextlib.contextmanager
-    def span(self, name: str, **meta: Any) -> Iterator[None]:
+    def span(self, name: str, parent: Optional[TraceContext] = None,
+             **meta: Any) -> Iterator[dict]:
+        """Record one span around the block; yields the (mutable) meta
+        dict so values learned inside the block (an allocated ticket
+        id) still land on the completed span. ``parent`` overrides the
+        thread's current context (the cross-ticket case: a dispatch
+        span parenting under ITS ticket's submit span, not under
+        whatever the pump thread happens to have open)."""
         if not self.enabled:
-            yield
+            yield meta
             return
         depth = getattr(self._local, "depth", 0)
         # analysis: ignore[unguarded-shared-mutation] — threading.local
         # storage: each thread mutates only its own depth slot
         self._local.depth = depth + 1
+        p = parent if parent is not None else self.current()
+        trace_id = p.trace_id if p is not None else _new_id()
+        span_id = _new_id()
+        stack = self._ctx_stack()
+        stack.append(TraceContext(trace_id, span_id))
         t0 = time.perf_counter()
         try:
-            yield
+            yield meta
         finally:
             dt = time.perf_counter() - t0
+            stack.pop()
             # analysis: ignore[unguarded-shared-mutation] — threading.local
             # storage: each thread mutates only its own depth slot
             self._local.depth = depth
             s = Span(name=name, start_s=t0, duration_s=dt,
                      thread=threading.get_ident(), depth=depth,
-                     meta=dict(meta))
+                     meta=dict(meta), trace_id=trace_id, span_id=span_id,
+                     parent_id=(p.span_id if p is not None else None),
+                     start_wall_s=t0 + self._wall_off, pid=self._pid)
             self._append(s)
 
     def instant(self, name: str, **meta: Any) -> None:
         """Record a zero-duration marker (the structured version of the
-        reference's ``__FILE__:__LINE__`` couts)."""
+        reference's ``__FILE__:__LINE__`` couts). Parents under the
+        thread's current context like a nested span would."""
         if not self.enabled:
             return
-        s = Span(name=name, start_s=time.perf_counter(), duration_s=0.0,
+        p = self.current()
+        t0 = time.perf_counter()
+        s = Span(name=name, start_s=t0, duration_s=0.0,
                  thread=threading.get_ident(),
-                 depth=getattr(self._local, "depth", 0), meta=dict(meta))
+                 depth=getattr(self._local, "depth", 0), meta=dict(meta),
+                 trace_id=(p.trace_id if p is not None else _new_id()),
+                 span_id=_new_id(),
+                 parent_id=(p.span_id if p is not None else None),
+                 start_wall_s=t0 + self._wall_off, pid=self._pid)
         self._append(s)
 
     def _append(self, s: Span) -> None:
         with self._lock:
+            self._seq += 1
+            s.seq = self._seq
             if len(self._spans) == self._spans.maxlen:
                 self.dropped += 1
             self._spans.append(s)
+
+    # -- cross-process shipping ---------------------------------------------
+
+    def spans_since(self, cursor: int) -> tuple[int, list[dict]]:
+        """``(new_cursor, span dicts)`` appended after ``cursor`` — the
+        heartbeat telemetry delta a member ships to its supervisor.
+        Spans that aged out of the ring before being shipped are simply
+        gone (the ring bounds memory; ``dropped`` counts them)."""
+        out: list[Span] = []
+        with self._lock:
+            cur = self._seq
+            for s in reversed(self._spans):
+                if s.seq <= cursor:
+                    break
+                out.append(s)
+        return cur, [s.to_dict() for s in reversed(out)]
+
+    def ingest(self, span_dicts: list, label: Optional[str] = None
+               ) -> int:
+        """Absorb spans recorded by ANOTHER process (heartbeat
+        telemetry / a fence's final cut) into this ring; returns how
+        many were absorbed. Spans stamped with THIS process's pid are
+        skipped — the loopback member transport shares the process
+        tracer, and shipping its spans over the socketpair must not
+        duplicate them. ``label`` names the sending process for
+        ``export_chrome``'s process metadata (m<slot>g<gen>)."""
+        n = 0
+        pids: set = set()
+        for d in span_dicts or ():
+            s = Span.from_dict(d)
+            if s.pid == self._pid:
+                continue
+            pids.add(s.pid)
+            self._append(s)
+            n += 1
+        if label is not None and pids:
+            # one label write per DISTINCT pid per call, not one lock
+            # round-trip per span — this runs on every heartbeat
+            with self._lock:
+                for p in pids:
+                    self._process_labels[p] = label
+        return n
+
+    def label_process(self, label: str, pid: Optional[int] = None) -> None:
+        """Name a pid in chrome exports (``process_name`` metadata)."""
+        with self._lock:
+            self._process_labels[self._pid if pid is None else pid] = label
 
     # -- inspection ---------------------------------------------------------
 
@@ -122,40 +328,80 @@ class Tracer:
             self.dropped = 0
 
     def summary(self) -> dict[str, dict[str, float]]:
-        """Per-name aggregates: count, total_s, mean_s, max_s."""
+        """Per-name aggregates: count, total_s, mean_s, max_s, p50_s,
+        p99_s (percentiles via the shared ``metrics.LatencyReservoir``
+        discipline — the per-stage rollup the telemetry plane
+        publishes). The reserved ``__tracer__`` entry carries
+        ``dropped``/``recorded`` so a truncated trace is explicit in
+        every artifact built from this summary."""
+        from .metrics import LatencyReservoir
+
         out: dict[str, dict[str, float]] = {}
-        for s in self.spans:
+        durs: dict[str, list[float]] = {}
+        spans = self.spans
+        for s in spans:
             agg = out.setdefault(
                 s.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
             agg["count"] += 1
             agg["total_s"] += s.duration_s
             agg["max_s"] = max(agg["max_s"], s.duration_s)
-        for agg in out.values():
+            durs.setdefault(s.name, []).append(s.duration_s)
+        for name, agg in out.items():
             agg["mean_s"] = agg["total_s"] / agg["count"]
+            d = sorted(durs[name])
+            agg["p50_s"] = LatencyReservoir.percentile_of(d, 0.50)
+            agg["p99_s"] = LatencyReservoir.percentile_of(d, 0.99)
+        out["__tracer__"] = {"dropped": self.dropped,
+                             "recorded": len(spans)}
         return out
 
     # -- export -------------------------------------------------------------
 
     def chrome_events(self) -> list[dict]:
-        """Spans as Chrome trace-event ``X`` (complete) events, µs."""
-        return [
-            {
+        """Spans as Chrome trace-event ``X`` (complete) events, µs.
+        Timestamps use the wall-clock anchor when present, so spans
+        ingested from member processes land on one merged timeline."""
+        events = []
+        with self._lock:
+            # ingest() mutates the label map under the lock from the
+            # heartbeat thread — the copy must be under it too
+            labels = dict(self._process_labels)
+        pids = set()
+        for s in self.spans:
+            ts = (s.start_wall_s if s.start_wall_s is not None
+                  else s.start_s)
+            pids.add(s.pid)
+            args = dict(s.meta)
+            if s.trace_id is not None:
+                args.update({"trace_id": s.trace_id, "span_id": s.span_id,
+                             "parent_id": s.parent_id})
+            events.append({
                 "name": s.name,
                 "ph": "X",
-                "ts": s.start_s * 1e6,
+                "ts": ts * 1e6,
                 "dur": s.duration_s * 1e6,
-                "pid": 1,
+                "pid": s.pid or 1,
                 "tid": s.thread,
-                "args": s.meta,
-            }
-            for s in self.spans
-        ]
+                "args": args,
+            })
+        # process metadata records: a merged multi-process trace must
+        # label members m<slot>g<gen>, not bare pids (ISSUE 15)
+        for pid in sorted(pids):
+            name = labels.get(pid)
+            if name is None:
+                name = ("fleet" if pid == self._pid else f"pid-{pid}")
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": pid or 1, "args": {"name": name}})
+        return events
 
     def export_chrome(self, path: str) -> str:
-        """Write the trace as a ``chrome://tracing``/Perfetto JSON file."""
+        """Write the trace as a ``chrome://tracing``/Perfetto JSON file.
+        The document carries the ring's ``dropped`` count at top level:
+        a truncated trace must say so in the artifact itself."""
         with open(path, "w") as f:
             json.dump({"traceEvents": self.chrome_events(),
-                       "displayTimeUnit": "ms"}, f)
+                       "displayTimeUnit": "ms",
+                       "dropped": self.dropped}, f)
         return path
 
     # -- device profiling ----------------------------------------------------

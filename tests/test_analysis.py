@@ -826,6 +826,62 @@ def test_wall_clock_in_test_catches_module_alias():
         ["wall-clock-in-test"])
 
 
+# -- naked-timer rule (ISSUE 15 satellite) ------------------------------------
+
+SERVE = "mpi_model_tpu/ensemble/fake.py"  # serving-scope pseudo path
+
+
+def test_naked_timer_positive():
+    src = ("import time\n"
+           "def dispatch():\n"
+           "    t0 = time.perf_counter()\n"
+           "    work()\n"
+           "    return time.monotonic() - t0\n")
+    assert rules_of(lint_source(src, SERVE)) == ["naked-timer"] * 2
+    # from-imports and module aliases are the same bypass
+    src2 = ("from time import perf_counter as pc\n"
+            "import time as _t\n"
+            "def dispatch():\n"
+            "    return pc() + _t.monotonic()\n")
+    assert rules_of(lint_source(src2, SERVE)) == ["naked-timer"] * 2
+
+
+def test_naked_timer_negative():
+    # references (the injectable-clock default) are not calls; modules
+    # outside ensemble/ (the tracing/metrics timing layer, tests) are
+    # out of scope; time.time()/sleep() are not the monotonic timers
+    src = ("import time\n"
+           "def build(clock=time.monotonic):\n"
+           "    time.sleep(0)\n"
+           "    return clock\n")
+    assert rules_of(lint_source(src, SERVE)) == []
+    src2 = ("import time\n"
+            "def span_body():\n"
+            "    return time.perf_counter()\n")
+    assert rules_of(lint_source(src2, "mpi_model_tpu/utils/fake.py")) == []
+    assert rules_of(lint_source(src2, "tests/test_fake.py")) == []
+    # a local name `time` without a real time import cannot fire
+    src3 = ("def f(time):\n"
+            "    return time.perf_counter()\n")
+    assert rules_of(lint_source(src3, SERVE)) == []
+
+
+def test_naked_timer_pragma_escape():
+    src = ("import time\n"
+           "def anchor():\n"
+           "    # analysis: ignore[naked-timer] — reservoir anchor\n"
+           "    return time.perf_counter()\n")
+    out = lint_source(src, SERVE)
+    assert rules_of(out) == []
+    assert [f.rule for f in out if f.suppressed] == ["naked-timer"]
+
+
+def test_naked_timer_is_warning_severity():
+    from mpi_model_tpu.analysis.registry import RULES, Severity
+
+    assert RULES["naked-timer"].severity is Severity.WARNING
+
+
 # -- concurrency audit (ISSUE 12 layer 3): lock model + acquisition graph -----
 
 def conc_rules_of(findings, unsuppressed=True):

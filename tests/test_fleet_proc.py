@@ -524,6 +524,72 @@ def test_journal_cli_main_runs_the_audit(tmp_path, capsys):
     assert journal_mod.main([str(tmp_path / "nope")]) == 2
 
 
+# -- heartbeat telemetry cache (ISSUE 15 satellite: PR 13 regression) ---------
+
+def test_heartbeat_telemetry_cache_reuses_idle_and_invalidates():
+    """The MemberServer stats cache: idle beats re-serve the cached
+    cut (no latency-reservoir sort per beat); the moment a counter
+    moves mid-soak, the state signature changes and the next beat
+    ships a FRESH cut reflecting the served work."""
+    client = spawn_loopback_member(
+        scen_model(), service_id="m9g0",
+        member_kwargs=dict(steps=4, retry="solo"))
+    server = client._server
+    assert client.heartbeat()
+    cut1 = server._stats_cached
+    assert client.stats()["scenarios"] == 0
+    assert client.heartbeat()
+    # idle: the cached object is re-served, not recomputed
+    assert server._stats_cached is cut1
+    t = client.submit(scen_space(0))
+    while client.poll(t) is None:
+        client.pump_once(force=True)
+    assert client.heartbeat()
+    # counters moved: the signature invalidated, the cut is fresh
+    assert server._stats_cached is not cut1
+    assert client.stats()["scenarios"] == 1
+    client.close()
+
+
+def test_fence_respawn_never_serves_a_retired_generations_cut():
+    """After proc_kill fences m<slot>g0 and the fleet respawns
+    m<slot>g1, the replacement's heartbeat telemetry must be ITS OWN
+    fresh cut (zero scenarios), never the retired generation's cached
+    one — while the fleet aggregate still carries the dead member's
+    absorbed work."""
+    clock = {"t": 0.0}
+    fleet = proc_fleet(services=2, clock=lambda: clock["t"],
+                       heartbeat_deadline_s=1.0, max_wait_s=1e9,
+                       max_batch=8)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        tickets = [fleet.submit(scen_space(i)) for i in range(4)]
+        # serve everything once so BOTH generations' cuts differ
+        outs = [fleet.result(t) for t in tickets]
+        assert len(outs) == 4
+        victim = next(s["service_id"]
+                      for s in fleet.stats()["services"]
+                      if s["scenarios"] > 0)
+        slot = int(victim[1:victim.index("g")])
+        with inject.armed(FaultPlan(
+                (Fault("proc_kill", channel=victim),))):
+            fleet.pump_once()
+            clock["t"] = 2.0
+            fleet.pump_once()
+    stats = fleet.stats()
+    assert stats["respawns"] >= 1
+    replacement = next(s for s in stats["services"]
+                       if s["service_id"] == f"m{slot}g1")
+    # the replacement's telemetry cut is its own: a fresh service with
+    # zero served scenarios, not the retired generation's cache
+    assert replacement["scenarios"] == 0
+    assert replacement["dispatches"] == 0
+    # ...while the fleet-level aggregate absorbed the dead member's
+    # work (nothing vanished with the fence)
+    assert stats["scenarios"] == 4
+    fleet.stop()
+
+
 # -- real spawned processes (slow) --------------------------------------------
 
 def _wait_until(pred, timeout_s=120.0):

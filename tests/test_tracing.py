@@ -102,12 +102,17 @@ def test_chrome_export(tmp_path):
     path = tr.export_chrome(str(tmp_path / "trace.json"))
     with open(path) as f:
         doc = json.load(f)
-    events = doc["traceEvents"]
-    assert len(events) == 2
-    for e in events:
+    # a truncated trace must say so IN the artifact (ISSUE 15 satellite)
+    assert doc["dropped"] == 0
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert len(spans) == 2
+    for e in spans:
         assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
-        assert e["ph"] == "X"
-    assert events[0]["args"] == {"detail": "x"}
+    assert metas and metas[0]["name"] == "process_name"
+    # span args carry the trace-context ids beside the user meta
+    assert spans[0]["args"]["detail"] == "x"
+    assert spans[0]["args"]["trace_id"] and spans[0]["args"]["span_id"]
 
 
 def test_model_execute_emits_phases():
@@ -158,6 +163,100 @@ def test_trace_span_uses_current_default():
     finally:
         set_tracer(prev)
     assert [s.name for s in tr.spans] == ["x"]
+
+
+def test_trace_context_ids_nest_and_propagate():
+    tr = Tracer()
+    with tr.span("outer") as meta:
+        meta["k"] = 1
+        ctx = tr.current()
+        with tr.span("inner"):
+            pass
+    outer = next(s for s in tr.spans if s.name == "outer")
+    inner = next(s for s in tr.spans if s.name == "inner")
+    assert outer.meta == {"k": 1}  # values set inside the block land
+    assert outer.span_id == ctx.span_id
+    assert outer.parent_id is None
+    assert inner.trace_id == outer.trace_id
+    assert inner.parent_id == outer.span_id
+    assert inner.span_id != outer.span_id
+
+
+def test_attach_adopts_a_remote_context():
+    from mpi_model_tpu.utils.tracing import TraceContext
+
+    tr = Tracer()
+    with tr.span("root"):
+        wire_meta = tr.current().to_meta()  # what crosses the frame
+    ctx = TraceContext.from_meta(wire_meta)
+    with tr.attach(ctx):
+        with tr.span("remote-child"):
+            pass
+    root = next(s for s in tr.spans if s.name == "root")
+    child = next(s for s in tr.spans if s.name == "remote-child")
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    # None-safe: a frame without trace meta attaches nothing
+    assert TraceContext.from_meta(None) is None
+    assert TraceContext.from_meta({"trace_id": 1}) is None
+    with tr.attach(None):
+        assert tr.current() is None
+
+
+def test_explicit_parent_overrides_thread_context():
+    tr = Tracer()
+    with tr.span("ticket-submit"):
+        ticket_ctx = tr.current()
+    with tr.span("pump-iteration"):
+        with tr.span("dispatch", parent=ticket_ctx):
+            pass
+    dispatch = next(s for s in tr.spans if s.name == "dispatch")
+    submit = next(s for s in tr.spans if s.name == "ticket-submit")
+    assert dispatch.parent_id == submit.span_id
+    assert dispatch.trace_id == submit.trace_id
+
+
+def test_spans_since_and_ingest_roundtrip():
+    tr = Tracer()
+    with tr.span("a"):
+        pass
+    cur, delta = tr.spans_since(0)
+    assert [d["name"] for d in delta] == ["a"]
+    cur2, delta2 = tr.spans_since(cur)
+    assert delta2 == [] and cur2 == cur
+    with tr.span("b"):
+        pass
+    _, delta3 = tr.spans_since(cur)
+    assert [d["name"] for d in delta3] == ["b"]
+    # ingest into another tracer: same-pid spans are SKIPPED (the
+    # loopback transport shares the process tracer — shipping them
+    # back must not duplicate), foreign pids merge in labeled
+    tr2 = Tracer()
+    assert tr2.ingest(delta) == 0
+    foreign = [dict(d, pid=999_999) for d in delta]
+    assert tr2.ingest(foreign, label="m3g1") == 1
+    s = tr2.spans[0]
+    assert s.pid == 999_999 and s.name == "a"
+    events = tr2.chrome_events()
+    names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert "m3g1" in names
+
+
+def test_summary_surfaces_dropped_and_percentiles():
+    tr = Tracer(max_spans=2)
+    for _ in range(4):
+        with tr.span("x"):
+            pass
+    s = tr.summary()
+    assert s["__tracer__"] == {"dropped": 2, "recorded": 2}
+    assert s["x"]["count"] == 2
+    assert 0 <= s["x"]["p50_s"] <= s["x"]["p99_s"] <= s["x"]["max_s"]
+    # the chrome artifact says it too
+    import tempfile, os
+    path = os.path.join(tempfile.mkdtemp(), "t.json")
+    tr.export_chrome(path)
+    with open(path) as f:
+        assert json.load(f)["dropped"] == 2
 
 
 @pytest.mark.slow  # heavyweight: jax.profiler device-trace round-trip (~20s)
