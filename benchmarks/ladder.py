@@ -891,9 +891,41 @@ def config12(quick: bool = False) -> dict:
             **row}
 
 
+def config13(quick: bool = False) -> dict:
+    """Mesh-sharded ensemble scaling (ISSUE 16): scenarios/s vs device
+    count (1/2/4/8) with the ensemble batch axis sharded over a
+    ``(batch × space)`` device mesh — every row gated bitwise-at-f64
+    against the single-device and serial paths before timing, with the
+    donated-window audit in the row, plus the fleet A/B leg (one
+    mesh-wide process member vs two ``member_env``-pinned members on
+    the same arrival schedule, both ledgers complete). Prefer ``python
+    bench.py --mesh``, which forces x64 and the 8-way host device
+    count BEFORE backend init; run inside the ladder, this config can
+    only request them via the environment — if jax already initialised
+    without x64 the row aborts rather than gating at f32, and rows the
+    rig cannot host are honest skips."""
+    os.environ.setdefault("JAX_ENABLE_X64", "true")
+    xf = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xf:
+        os.environ["XLA_FLAGS"] = (
+            xf + " --xla_force_host_platform_device_count=8").strip()
+    import bench as bench_mod
+
+    g = 96 if quick else 512
+    row = bench_mod.bench_ensemble_mesh(
+        grid=g, B=8, steps=4 if quick else 8,
+        trials=1 if quick else 5,
+        fleet_scenarios=12 if quick else 24)
+    return {"config": 13, "flow": "diffusion (per-scenario rates)",
+            "strategy": "mesh-sharded ensemble: (batch x space) "
+                        "scaling + fleet A/B (mesh-wide member vs "
+                        "env-pinned members)",
+            **row}
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12}
+           11: config11, 12: config12, 13: config13}
 
 
 def sweep_blocks(grid: int = 8192, dtype_name: str = "bfloat16") -> list:

@@ -33,9 +33,17 @@ audited contracts:
     flags come out of the Pallas pass itself (ISSUE 8's structural
     win), never a separate per-step re-scan.
 
+``jaxpr-batch-psum``
+    the mesh-sharded ensemble runner's per-scenario stat lanes reduce
+    over the space axes only: exactly one f64 ``reduce_sum`` per
+    channel at batch-grid size (``[B,H,W] → [B]``), nothing else that
+    large — the batch-sharded conservation contract of ISSUE 16.
+
 Audited impls: ``dense`` (the XLA stencil step), ``composed`` (k-step
 filter), ``active`` (tile-skipping engine), ``ensemble`` (the vmapped
-parametric scenario step), ``active_fused`` (the stateless fused
+parametric scenario step), ``ensemble_mesh`` (the sharding-constrained
+ensemble runner over a (batch, space) mesh, with the batch-psum
+stat-lane contract), ``active_fused`` (the stateless fused
 Pallas active step — scalar-prefetch-argument and halo k·passes ==
 substeps contracts) and ``active_fused_runner`` (the amortized fused
 loop — the jaxpr-fused-flags contract). The dense Pallas kernel impl
@@ -90,6 +98,12 @@ _register("jaxpr-fused-flags",
           "reduction at tile size or larger outside the kernel — "
           "activity flags come out of the Pallas pass, never a "
           "separate per-step reduction")
+_register("jaxpr-batch-psum",
+          "the mesh-sharded ensemble runner's per-scenario stat lanes "
+          "must reduce over the space axes only (one f64 reduce_sum "
+          "per channel, [B,H,W] -> [B]) — a full-batch or "
+          "wrong-dtype reduction would break the batch-sharded "
+          "conservation contract")
 
 
 @dataclasses.dataclass
@@ -115,6 +129,12 @@ class BuiltStep:
     #: when set (tile cell count), enforce jaxpr-fused-flags on every
     #: innermost while body that contains a pallas_call
     fused_flags_tile_elems: Optional[int] = None
+    #: mesh-runner stat-lane contract (ISSUE 16): {"count": n_channels,
+    #: "dtype": np dtype, "min_elems": B*H*W} — exactly ``count``
+    #: reduce_sum eqns at batch-grid size, each producing ``dtype``
+    #: (the [B,H,W] -> [B] per-scenario reductions, and nothing else
+    #: at that size)
+    batch_psum: Optional[dict] = None
 
 
 #: impl name → zero-arg builder (registered below)
@@ -202,6 +222,52 @@ def _build_ensemble() -> BuiltStep:
         (vals_b, jax.ShapeDtypeStruct(rates.shape, np.float64),
          jax.ShapeDtypeStruct(frozens.shape, np.float64)),
         space.dtype, v0.dtype.itemsize * v0.size, model.offsets, 1)
+
+
+@contract("ensemble_mesh")
+def _build_ensemble_mesh() -> BuiltStep:
+    # the mesh-sharded ensemble runner (ISSUE 16): the REAL compiled
+    # artifact — EnsembleExecutor._build_xla with the (batch, space)
+    # carry constraint — plus the per-scenario stat lanes
+    # (batched_totals' float branch: f64 sums over the space axes).
+    # Degrades to a 1-device mesh when the rig has a single CPU device
+    # (`analysis --strict` runs without the test conftest's 8-device
+    # XLA flag), which still audits the sharding-constrained lowering.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..ensemble.batch import EnsembleExecutor, EnsembleSpace, flow_params
+    from ..ensemble.mesh import make_ensemble_mesh
+
+    space, model = _space_model("float64", 16)
+    cpu = jax.devices("cpu")
+    n = max(1, min(2, len(cpu)))
+    emesh = make_ensemble_mesh(batch=n, devices=cpu[:n])
+    B = 2 * n
+    espace = EnsembleSpace.stack([space] * B)
+    ex = EnsembleExecutor(mesh=emesh)
+    run = ex.runner_for(model, espace)
+    rates, frozens = flow_params([model] * B)
+
+    def fn(vb, rates_b, frozens_b, q, r):
+        out = run(vb, rates_b, frozens_b, q, r)
+        # the per-scenario stat lanes: device-side f64 sums over the
+        # space axes only — [B,H,W] -> [B], batch-sharded throughout
+        return {k: jnp.sum(v, axis=(1, 2), dtype=jnp.float64)
+                for k, v in out.items()}
+
+    vals_b = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+              for k, v in espace.values.items()}
+    args = (vals_b, _sds(rates), _sds(frozens),
+            jax.ShapeDtypeStruct((), np.dtype("int32")),
+            jax.ShapeDtypeStruct((), np.dtype("int32")))
+    v0 = next(iter(space.values.values()))
+    return BuiltStep(
+        "ensemble_mesh", fn, args, space.dtype,
+        v0.dtype.itemsize * v0.size, model.offsets, 1,
+        batch_psum={"count": len(espace.values),
+                    "dtype": np.dtype("float64"),
+                    "min_elems": B * space.shape[0] * space.shape[1]})
 
 
 @contract("active_fused")
@@ -529,6 +595,41 @@ def audit_built(built: BuiltStep) -> list[Finding]:
                 "jaxpr-consts", Severity.ERROR, where, 0,
                 f"the {built.impl} step lowered no pallas_call at all — "
                 "the fused kernel is not in the hot path"))
+
+    # jaxpr-batch-psum (ISSUE 16): the mesh runner's per-scenario stat
+    # lanes — exactly one batch-grid-size reduce_sum per channel, each
+    # producing f64. More means a stray whole-state reduction crept
+    # into the hot path; fewer (or a narrower dtype) means the [B]
+    # conservation lanes are no longer the audited f64 space-axis sums
+    if built.batch_psum is not None:
+        import math
+        spec = built.batch_psum
+        found = []
+        for eqn in _iter_eqns(closed.jaxpr):
+            if eqn.primitive.name != "reduce_sum":
+                continue
+            for v in eqn.invars:
+                aval = getattr(v, "aval", None)
+                size = int(math.prod(getattr(aval, "shape", ())))
+                if size >= int(spec["min_elems"]):
+                    found.append(eqn)
+                    break
+        if len(found) != int(spec["count"]):
+            findings.append(Finding(
+                "jaxpr-batch-psum", Severity.ERROR, where, 0,
+                f"{len(found)} batch-grid-size reduce_sum eqn(s) in the "
+                f"{built.impl} runner, contract expects exactly "
+                f"{spec['count']} (one [B,H,W] -> [B] stat reduction "
+                "per channel, nothing else at that size)"))
+        for eqn in found:
+            got = np.dtype(eqn.outvars[0].aval.dtype)
+            if got != np.dtype(spec["dtype"]):
+                findings.append(Finding(
+                    "jaxpr-batch-psum", Severity.ERROR, where, 0,
+                    f"a batch-axis stat reduction in the {built.impl} "
+                    f"runner produces {got.name}, contract requires "
+                    f"{np.dtype(spec['dtype']).name} — the conservation "
+                    "lanes must stay f64"))
 
     # jaxpr-fused-flags: every innermost while body that runs the
     # kernel must be free of tile-or-larger reductions outside it —

@@ -246,6 +246,51 @@ def _build_executor(args, model):
                             compute_dtype=_compute_dtype(args))
 
 
+def _parse_ensemble_mesh(spec):
+    """``--ensemble-mesh B`` or ``BxS`` → the scheduler's mesh spec
+    (batch extent, or (batch, space) pair). The concrete mesh resolves
+    against the running process's devices — or, with
+    --serve-transport=process, against each CHILD's (possibly
+    --serve-member-env-pinned) device set."""
+    if spec is None:
+        return None
+    s = str(spec).lower().replace("×", "x")
+    try:
+        if "x" in s:
+            b, sp = s.split("x", 1)
+            b, sp = int(b), int(sp)
+            if b < 1 or sp < 1:
+                raise ValueError
+            return (b, sp)
+        b = int(s)
+        if b < 1:
+            raise ValueError
+        return b
+    except ValueError:
+        raise SystemExit(
+            f"--ensemble-mesh expects a batch extent B or BxS "
+            f"(batch x space devices), got {spec!r}")
+
+
+def _parse_member_env(pairs):
+    """Repeatable ``--serve-member-env KEY=VAL`` → the env dict laid
+    over every spawned member (per-slot pinning is API-level:
+    ``FleetSupervisor(member_env=[{...}, {...}])``)."""
+    if not pairs:
+        return None
+    env = {}
+    for p in pairs:
+        if "=" not in p:
+            raise SystemExit(
+                f"--serve-member-env expects KEY=VAL, got {p!r}")
+        k, v = p.split("=", 1)
+        if not k:
+            raise SystemExit(
+                f"--serve-member-env expects a non-empty KEY, got {p!r}")
+        env[k] = v
+    return env
+
+
 def _run_ensemble(args, space, model) -> int:
     """``--ensemble B``: B copies of the configured scenario through the
     full serving stack (EnsembleService → bucketed scheduler → batched
@@ -263,7 +308,8 @@ def _run_ensemble(args, space, model) -> int:
         model, steps=steps, impl=args.ensemble_impl,
         substeps=args.substeps, buckets=buckets_for(B),
         compute_dtype=_compute_dtype(args), check_conservation=False,
-        compile_cache=_cache_spec(args, "auto"))
+        compile_cache=_cache_spec(args, "auto"),
+        mesh=_parse_ensemble_mesh(args.ensemble_mesh))
     t0 = _time.perf_counter()
     try:
         tickets = [svc.submit(space) for _ in range(B)]
@@ -300,6 +346,7 @@ def _run_ensemble(args, space, model) -> int:
         "wall_s": wall,
         "impl": args.ensemble_impl,
         "substeps": args.substeps,
+        "mesh": st["mesh"],
         "scenarios_per_s": st["scenarios_per_s"],
         "batch_occupancy": st["batch_occupancy"],
         "compile_cache_hits": st["compile_cache_hits"],
@@ -350,7 +397,11 @@ def _run_serve(args, space, model) -> int:
         # ISSUE 14: capacity-aware paging — overload hibernates to the
         # vault instead of shedding (both flags or neither, validated)
         residency_budget=args.residency_budget,
-        hibernate_dir=args.hibernate_dir)
+        hibernate_dir=args.hibernate_dir,
+        # ISSUE 16: the (batch × space) ensemble mesh — an int/pair
+        # spec, so over process transport each CHILD resolves it
+        # against its own (possibly pinned) device set
+        mesh=_parse_ensemble_mesh(args.ensemble_mesh))
     if args.status:
         # --status is the "I am watching this soak" flag: flight dumps
         # (the ring cut beside every fence/quarantine/HibernationError)
@@ -367,6 +418,8 @@ def _run_serve(args, space, model) -> int:
         # someone must heartbeat, fence and respawn the children
         svc = FleetSupervisor(model, services=args.serve_services,
                               member_transport=args.serve_transport,
+                              member_env=_parse_member_env(
+                                  args.serve_member_env),
                               **svc_kw)
     else:
         svc = AsyncEnsembleService(model, **svc_kw)
@@ -578,6 +631,11 @@ def cmd_run(args) -> int:
             raise SystemExit(
                 "scenario tiering needs BOTH --residency-budget and "
                 "--hibernate-dir (or neither)")
+        if args.serve_member_env and args.serve_transport != "process":
+            raise SystemExit(
+                "--serve-member-env pins a spawned CHILD's environment "
+                "(device visibility); it needs "
+                "--serve-transport=process")
         if args.residency_budget is not None \
                 and args.residency_budget < 1:
             raise SystemExit(
@@ -591,6 +649,7 @@ def cmd_run(args) -> int:
                 ("--serve-scenarios", args.serve_scenarios, 64),
                 ("--serve-services", args.serve_services, 1),
                 ("--serve-transport", args.serve_transport, "inproc"),
+                ("--serve-member-env", args.serve_member_env or None, None),
                 ("--residency-budget", args.residency_budget, None),
                 ("--hibernate-dir", args.hibernate_dir, None),
                 ("--status", args.status, None),
@@ -628,6 +687,17 @@ def cmd_run(args) -> int:
     elif args.ensemble_impl != "xla" and not args.serve:
         raise SystemExit("--ensemble-impl applies to ensemble/serve "
                          "runs; add --ensemble=B or --serve")
+    if args.ensemble_mesh is not None:
+        if args.ensemble is None and not args.serve:
+            raise SystemExit(
+                "--ensemble-mesh shards the ensemble batch axis over "
+                "devices; add --ensemble=B or --serve (for the spatial "
+                "mesh of a single run use --mesh=LxC)")
+        if args.ensemble_impl != "xla":
+            raise SystemExit(
+                "--ensemble-mesh requires --ensemble-impl=xla (the "
+                "other engines carry per-lane state the batch-axis "
+                "sharding contract does not cover)")
     if args.owner_of is not None and args.rectangular is None:
         raise SystemExit(
             "--owner-of reports the 2-D block owner map; add "
@@ -957,6 +1027,24 @@ def main(argv: Optional[list[str]] = None) -> int:
                      "protocol (heartbeat health, fence + respawn on "
                      "a killed member, per-member device pinning via "
                      "the child environment)")
+    run.add_argument("--serve-member-env", action="append", default=None,
+                     metavar="KEY=VAL",
+                     help="with --serve-transport=process: lay KEY=VAL "
+                     "over every spawned member's environment before "
+                     "exec (repeatable) — the device-pinning contract "
+                     "(e.g. JAX_PLATFORMS, CUDA_VISIBLE_DEVICES, "
+                     "XLA_FLAGS); per-slot pins are API-level "
+                     "(FleetSupervisor(member_env=[{...}, ...]))")
+    run.add_argument("--ensemble-mesh", default=None, metavar="B[xS]",
+                     help="shard the ensemble batch axis over a device "
+                     "mesh (ISSUE 16): B = scenario lanes split over B "
+                     "devices; BxS adds an S-way space axis inside "
+                     "every lane (2-D batch x space layout). Dispatches "
+                     "pad to (bucket x B) with inert zero scenarios; "
+                     "with --serve-transport=process each member "
+                     "resolves the mesh against its own (possibly "
+                     "--serve-member-env-pinned) devices. Requires "
+                     "--ensemble-impl=xla")
     run.add_argument("--arrival-rate", type=float, default=None,
                      metavar="HZ",
                      help="open-loop arrival rate in scenarios/s "

@@ -4,6 +4,12 @@ compile cache.
 - ``batch``     — EnsembleSpace (stacked SoA pytree, leading batch axis),
                   the vmapped parametric step, per-scenario conservation,
                   EnsembleExecutor (impl="xla" | "pipeline");
+- ``mesh``      — the (batch × space) device mesh layer (ISSUE 16):
+                  ``EnsembleMesh`` placement contract for ``[B,H,W]``
+                  SoA channels and ``[B,F]`` parameter lanes, the
+                  pad-to-(bucket × mesh) round-up, and the wire-safe
+                  ``(batch, space)`` spec ``resolve_ensemble_mesh``
+                  rebuilds against a member's local devices;
 - ``scheduler`` — scenario queue with bucketed batching (pad to bucket,
                   max-wait/max-batch flush, runner cache + hit counters,
                   thread-safe launch/complete dispatch phases, ticket
@@ -57,6 +63,7 @@ from .batch import (
 )
 from .fleet import AutoscalePolicy, FleetSupervisor, MemberFailure
 from .journal import TicketJournal
+from .mesh import EnsembleMesh, make_ensemble_mesh, resolve_ensemble_mesh
 from .scheduler import (DEFAULT_BUCKETS, DispatchTimeout,
                         EnsembleScheduler, TicketExpired,
                         TicketNotMigratable, buckets_for)
@@ -77,6 +84,7 @@ __all__ = [
     "EnsembleConservationError",
     "EnsembleExecutor",
     "EnsembleInFlight",
+    "EnsembleMesh",
     "EnsembleScheduler",
     "EnsembleService",
     "EnsembleSpace",
@@ -94,6 +102,8 @@ __all__ = [
     "buckets_for",
     "complete_ensemble",
     "launch_ensemble",
+    "make_ensemble_mesh",
+    "resolve_ensemble_mesh",
     "run_ensemble",
     "run_soak",
     "structure_key",

@@ -478,23 +478,43 @@ class EnsembleExecutor:
     (kernel-fused on the pipeline path; composed singles on the XLA
     path); any remainder runs as single steps, so semantics are
     independent of the setting. Runners are cached by
-    ``(batch, shape, channel dtypes, impl, substeps, structure)`` —
-    ``builds``/``cache_hits`` count misses/hits for the serving
-    counters.
+    ``(batch, shape, channel dtypes, impl, substeps, structure,
+    mesh token)`` — ``builds``/``cache_hits`` count misses/hits for
+    the serving counters.
+
+    ``mesh`` (xla impl only) is an ``ensemble.mesh.EnsembleMesh``:
+    runners constrain the ``[B,H,W]`` carry to
+    ``P("batch", "space", None)`` so GSPMD shards scenario lanes over
+    the batch axis (and grid rows over the space axis) instead of
+    replicating — the ISSUE 16 2-D data-parallel layout. The mesh
+    token (axis extents + device ids) is part of the runner cache key,
+    so resizing the mesh — or the CPU rig's
+    ``--xla_force_host_platform_device_count`` — can never serve a
+    stale compiled runner.
     """
 
     comm_size = 1
 
     def __init__(self, impl: str = "xla", substeps: int = 1,
-                 compute_dtype=None):
+                 compute_dtype=None, mesh=None):
         if impl not in ("xla", "pipeline", "active", "active_fused"):
             raise ValueError(
                 f"unknown ensemble impl {impl!r} (expected 'xla', "
                 "'pipeline', 'active' or 'active_fused')")
+        if mesh is not None and impl != "xla":
+            raise ValueError(
+                f"mesh-sharded dispatch supports impl='xla' only, got "
+                f"{impl!r} (the other impls carry per-lane state the "
+                "batch-axis sharding contract does not cover)")
         self.impl = impl
         self.substeps = max(1, int(substeps))
         #: interior-tile math dtype for the pipeline kernel (None → f32)
         self.compute_dtype = compute_dtype
+        #: ``EnsembleMesh`` (or None): the (batch, space) placement the
+        #: xla runners constrain their carry to. Plain attribute — the
+        #: cache key reads ``mesh.token()`` per lookup, so swapping the
+        #: mesh rebuilds instead of serving a stale runner.
+        self.mesh = mesh
         self.last_impl: Optional[str] = None
         #: per-run report detail (impl="active" stats); None otherwise
         self.last_backend_report: Optional[dict] = None
@@ -528,7 +548,8 @@ class EnsembleExecutor:
         key = (espace.batch, espace.shape, self.impl, self.substeps,
                str(self.compute_dtype) if self.compute_dtype is not None
                else None,
-               structure_key(model, espace), bool(donate))
+               structure_key(model, espace), bool(donate),
+               self.mesh.token() if self.mesh is not None else None)
         if uniform_rates is not None:
             key = key + (tuple(sorted(uniform_rates.items())),)
         # build INSIDE the lock: serializing a miss is the point — two
@@ -561,6 +582,7 @@ class EnsembleExecutor:
                    donate: bool = False):
         single = make_scenario_step(model, espace)
         substeps = self.substeps
+        mesh = self.mesh
 
         def stepk(v, rr, ff):
             for _ in range(substeps):
@@ -571,13 +593,30 @@ class EnsembleExecutor:
         b1 = (bk if substeps == 1
               else jax.vmap(single, in_axes=(0, 0, 0)))
 
+        if mesh is not None:
+            # Constrain the carry to the (batch, space) layout at entry
+            # and on every loop-body output: GSPMD propagates shardings
+            # through the fori_loop, but pinning the body output keeps
+            # the carry from collapsing to replicated on any dtype or
+            # reshape boundary the flows introduce (the idiom of
+            # parallel.AutoShardedExecutor, extended with a batch axis).
+            vsh = mesh.value_sharding()
+
+            def _pin(vb):
+                return {k: jax.lax.with_sharding_constraint(v, vsh)
+                        for k, v in vb.items()}
+        else:
+            def _pin(vb):
+                return vb
+
         def run(vb, rates_b, frozens_b, q, r):
             # q k-step calls + r single steps == num_steps; both counts
             # are TRACED scalars, so one compile serves every step count
+            vb = _pin(vb)
             vb = jax.lax.fori_loop(
-                0, q, lambda i, c: bk(c, rates_b, frozens_b), vb)
+                0, q, lambda i, c: _pin(bk(c, rates_b, frozens_b)), vb)
             vb = jax.lax.fori_loop(
-                0, r, lambda i, c: b1(c, rates_b, frozens_b), vb)
+                0, r, lambda i, c: _pin(b1(c, rates_b, frozens_b)), vb)
             return vb
 
         # donation aliases the output onto the input buffers — the SAME
@@ -854,16 +893,35 @@ def launch_ensemble(model, spaces, *, models=None, executor=None,
     # identically zero regardless of their (zero-rate) parameter lanes
     uniform = (None if executor.impl != "pipeline"
                else _uniform_rates(model, models, rates_np[:count]))
+    mesh = getattr(executor, "mesh", None)
+    if mesh is not None:
+        # divisibility is validated BEFORE compiling: the scheduler pads
+        # to (bucket × mesh) so it never trips this; direct callers get
+        # told to pad rather than a GSPMD shape error mid-trace
+        mesh.validate(espace.batch, espace.shape)
     runner = executor.runner_for(model, espace, uniform, donate=donate)
     # f64 host params: jnp.asarray keeps f64 under x64 (bit-parity with
     # the serial path's python-float rates), f32 otherwise
     rates_b = jnp.asarray(rates_np)
     frozens_b = jnp.asarray(frozens_np)
+    if mesh is not None:
+        # scatter the [B,H,W] SoA channels and [B,F] parameter lanes
+        # onto the mesh BEFORE dispatch: each device receives exactly
+        # its own scenario lanes (and row block), and the runner's
+        # carry constraint keeps them there across windows — no
+        # replicate-then-slice on the first call
+        espace = dataclasses.replace(
+            espace, values=mesh.place_values(espace.values))
+        rates_b = mesh.place_lanes(rates_b)
+        frozens_b = mesh.place_lanes(frozens_b)
 
     # initial totals are dispatched BEFORE the (possibly donating)
     # runner call: the runtime sequences the donated execution after
-    # these reads, so the totals see the pre-step state
-    initial_d = batched_totals(espace.values)
+    # these reads, so the totals see the pre-step state. A space-cut
+    # mesh reshards through totals_view first — the bitwise-at-f64
+    # stat contract needs the single-device reduction order
+    initial_d = batched_totals(espace.values if mesh is None
+                               else mesh.totals_view(espace.values))
     # chaos seam (resilience.inject): lane poisons are CAPTURED at
     # launch (the scheduler's ticket→lane push window is open now) and
     # applied at complete — one firing per dispatch either way
@@ -969,7 +1027,9 @@ def complete_ensemble(inflight: EnsembleInFlight, *,
             poisons.append((f.lane if f.lane is not None else 0, f))
     for lane, fault in poisons:
         out = inject.poison_lane_values(out, lane, fault)
-    final_d = batched_totals(out)
+    mesh = getattr(executor, "mesh", None)
+    final_d = batched_totals(out if mesh is None
+                             else mesh.totals_view(out))
     executor.last_impl = executor.impl
     executor.last_backend_report = None
     if fb_arr is not None:

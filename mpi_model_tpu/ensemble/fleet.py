@@ -218,10 +218,22 @@ class FleetSupervisor:
         #: the injectable clock → fence + respawn gen+1); every RPC is
         #: bounded by ``rpc_deadline_s`` and a wire failure is a
         #: MEMBER fault, never a ticket outcome. ``member_env`` is the
-        #: device-pinning env contract laid over each spawned child.
+        #: device-pinning env contract laid over each spawned child: a
+        #: dict pins every member identically; a SEQUENCE of dicts pins
+        #: per slot (``member_env[slot % len]`` — how N members split
+        #: one host's chips, e.g. disjoint ``CUDA_VISIBLE_DEVICES``,
+        #: the ISSUE 16 N-single-chip-members layout); a callable gets
+        #: the slot and returns the env.
         self._transport = member_transport
         self._heartbeat_deadline = float(heartbeat_deadline_s)
         self._rpc_deadline = float(rpc_deadline_s)
+        if (member_env is not None and not isinstance(member_env, dict)
+                and not callable(member_env)):
+            member_env = [dict(e) if e else {} for e in member_env]
+            if not member_env:
+                raise ValueError(
+                    "member_env sequence must not be empty (pass None "
+                    "for no pinning)")
         self._member_env = member_env
         self._spawner = member_spawner
         if member_transport == "process":
@@ -337,6 +349,18 @@ class FleetSupervisor:
 
     # -- lifecycle -----------------------------------------------------------
 
+    def _member_env_for(self, slot: int) -> Optional[dict]:
+        """Resolve the device-pinning env for one member slot: uniform
+        dict, per-slot sequence (``slot % len`` — a respawned gen+1
+        inherits its slot's pin, so fencing never migrates a member
+        onto another member's chips), or slot → env callable."""
+        me = self._member_env
+        if me is None or isinstance(me, dict):
+            return me
+        if callable(me):
+            return me(slot)
+        return me[slot % len(me)]
+
     def _make_member(self, slot: int, gen: int) -> _Member:
         """Build one member WITHOUT touching fleet state — safe to run
         outside the fleet lock (ISSUE 14 satellite: a process member's
@@ -358,7 +382,7 @@ class FleetSupervisor:
                 clock=self._clock,
                 heartbeat_deadline_s=self._heartbeat_deadline,
                 rpc_deadline_s=self._rpc_deadline,
-                member_env=self._member_env,
+                member_env=self._member_env_for(slot),
                 pump_mode="thread" if self._threaded else "rpc")
         if gen > 0:
             # observability: how many times this fleet replaced a

@@ -93,7 +93,7 @@ SPAWNABLE_KWARGS = frozenset((
     "compute_dtype", "check_conservation", "tolerance", "rtol", "retry",
     "dispatch_deadline_s", "degrade_after", "retry_budget", "windows",
     "donate", "max_queue", "deadline_s", "poll_interval_s",
-    "compile_cache",
+    "compile_cache", "mesh",
 ))
 
 #: how long the spawner waits for the child to import jax, build its
@@ -132,6 +132,30 @@ def _report_from_meta(m: dict):
         final_total=m.get("final_total", {}), last_execute=[],
         wall_time_s=m.get("wall_time_s", 0.0),
         backend_report=m.get("backend_report"))
+
+
+_BACKEND_DEVICES: Optional[dict] = None
+
+
+def _backend_devices() -> dict:
+    """This process's visible accelerator set — the observable the
+    ``member_env`` device-pinning contract is asserted against
+    (ISSUE 16 satellite): a member spawned with a pinned env (e.g.
+    ``CUDA_VISIBLE_DEVICES`` or the CPU rig's
+    ``--xla_force_host_platform_device_count``) must report exactly
+    the devices its pin allows. Computed once — a process's device set
+    is fixed after backend init."""
+    global _BACKEND_DEVICES
+    if _BACKEND_DEVICES is None:
+        import jax
+
+        devs = jax.devices()
+        _BACKEND_DEVICES = {
+            "platform": devs[0].platform if devs else None,
+            "device_count": len(devs),
+            "devices": [str(d) for d in devs],
+        }
+    return _BACKEND_DEVICES
 
 
 def _rss_bytes() -> Optional[int]:
@@ -464,6 +488,7 @@ class MemberServer:
             "impl": sched.executor.impl,
             "counters": counters,
             "rss_bytes": _rss_bytes(),
+            "backend": _backend_devices(),
             "pid": os.getpid(),
             "stats": stats,
             "spans": spans,
@@ -782,6 +807,14 @@ class ProcessMemberClient:
         with self._lock:
             return bool(self._telemetry.get("due", False))
 
+    def telemetry(self) -> dict:
+        """The member's last-heartbeat telemetry cut (RPC-free copy).
+        ``telemetry()["backend"]`` is what the ``member_env``
+        device-pinning contract is asserted against: the child's OWN
+        visible device set as shipped over the wire."""
+        with self._lock:
+            return dict(self._telemetry)
+
     def stats(self) -> dict:
         """The member's last-heartbeat stats cut plus the client-side
         wire observability (bytes in/out, heartbeat age, pid, rss) —
@@ -793,6 +826,9 @@ class ProcessMemberClient:
                 "transport": "process",
                 "rss_bytes": self._telemetry.get("rss_bytes"),
                 "member_pid": self._telemetry.get("pid"),
+                # the child's visible device set (the member_env pin's
+                # observable) rides the per-member fleet breakdown
+                "backend": self._telemetry.get("backend"),
                 "heartbeat_age_s": self._clock() - self._last_beat,
                 "wire_bytes_in": self._conn.bytes_in,
                 "wire_bytes_out": self._conn.bytes_out,
@@ -895,6 +931,17 @@ def _encode_member_kwargs(member_kwargs: dict) -> dict:
             v = str(jnp.dtype(v))
         elif k == "buckets":
             v = [int(b) for b in v]
+        elif k == "mesh" and v is not None:
+            # a mesh crosses as its (batch, space) extents — the child
+            # rebuilds it over ITS OWN (possibly member_env-pinned)
+            # device set; concrete device handles never cross exec
+            if isinstance(v, int):
+                v = [int(v), 1]
+            elif hasattr(v, "batch") and hasattr(v, "space"):
+                v = [int(v.batch), int(v.space)]
+            else:
+                b, s = v
+                v = [int(b), int(s)]
         out[k] = v
     json.dumps(out)  # fail at spawn, not in the child's stderr
     return out
@@ -908,6 +955,8 @@ def _decode_member_kwargs(cfg: dict) -> dict:
         out["compute_dtype"] = jnp.dtype(out["compute_dtype"])
     if out.get("buckets") is not None:
         out["buckets"] = tuple(out["buckets"])
+    if out.get("mesh") is not None:
+        out["mesh"] = tuple(out["mesh"])
     return out
 
 

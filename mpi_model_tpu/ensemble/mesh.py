@@ -1,0 +1,183 @@
+"""Batch-axis ensemble mesh: 2-D (batch × space) data parallelism.
+
+The ensemble ``[B, H, W]`` SoA pytree is the unit the whole serving
+stack dispatches, and until ISSUE 16 it lived on ONE device — a fleet
+member on an 8-chip host used 1/8th of its silicon (ROADMAP direction
+1). This module is the placement layer that fixes that: an
+``EnsembleMesh`` wraps a ``jax.sharding.Mesh`` with axes
+``("batch", "space")`` and owns the two placement contracts the
+executor and scheduler build on:
+
+- ``[B, H, W]`` state channels shard as ``P("batch", "space", None)``
+  — scenario lanes over the batch axis, grid rows over the space axis
+  (extent 1 by default, so the pure batch-parallel layout is just the
+  degenerate 2-D mesh). This composes the ensemble batch with the
+  spatial row-striping of ``parallel.mesh`` in ONE mesh, so bucket
+  size — not device count — picks the layout.
+- ``[B, F]`` rate/frozen parameter lanes shard as ``P("batch")``:
+  each device holds exactly the parameters of its own scenario lanes.
+
+Per-scenario stat/conservation reductions (``batched_totals``) sum
+over axes ``(1, 2)`` only, so their ``[B]`` outputs stay batch-sharded
+and XLA lowers the reduction as per-device partial sums — no batch-axis
+collective at all on the stats path; cross-device traffic exists only
+where the space axis is cut (halo exchange), exactly like the spatial
+stats. The jaxpr auditor's ``ensemble_mesh`` golden pins this contract.
+When the space axis IS cut, the totals input first reshards through
+``totals_view`` (batch-only sharding) so each lane's f64 reduction
+keeps the single-device rounding order — the bitwise-at-f64 stat gate
+holds on the 2-D layout too.
+
+Divisibility is the scheduler's job, not the executor's: dispatch pads
+to (bucket × batch extent) with inert zero scenarios (the IR zero-rate
+contract makes pads provably no-op), so ``validate`` here is a
+tripwire for direct ``launch_ensemble`` callers, not a path the
+scheduler can reach.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import _devices, put_global
+
+BATCH_AXIS = "batch"
+SPACE_AXIS = "space"
+
+
+@dataclasses.dataclass(frozen=True)
+class EnsembleMesh:
+    """A ``(batch, space)`` device mesh plus the ensemble placement
+    contract (module docstring). Hashable-by-``token()`` so runner
+    caches can key on it."""
+
+    mesh: Mesh
+
+    @property
+    def batch(self) -> int:
+        """Batch-axis extent: scenario lanes per dispatch must be a
+        multiple of this (the scheduler pads to it)."""
+        return self.mesh.shape[BATCH_AXIS]
+
+    @property
+    def space(self) -> int:
+        """Space-axis extent: grid rows divide over this many devices
+        inside every lane."""
+        return self.mesh.shape[SPACE_AXIS]
+
+    @property
+    def devices(self) -> int:
+        return self.batch * self.space
+
+    def token(self) -> tuple:
+        """Hashable identity for cache keys: axis extents plus the
+        concrete device ids. Two meshes of the same shape over
+        DIFFERENT devices are distinct tokens — a resized
+        ``--xla_force_host_platform_device_count`` rig can never serve
+        a stale compiled runner (ISSUE 16 satellite fix)."""
+        return (self.batch, self.space,
+                tuple(int(d.id) for d in self.mesh.devices.flat))
+
+    def value_spec(self) -> P:
+        """Spec for ``[B, H, W]`` state channels: lanes over batch,
+        grid rows over space."""
+        return P(BATCH_AXIS, SPACE_AXIS, None)
+
+    def lane_spec(self) -> P:
+        """Spec for ``[B, F]`` rate/frozen lanes and ``[B]`` stat
+        lanes: batch-sharded, parameters co-located with their lanes."""
+        return P(BATCH_AXIS)
+
+    def value_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.value_spec())
+
+    def lane_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.lane_spec())
+
+    def round_up(self, k: int) -> int:
+        """Smallest multiple of the batch extent ≥ k — the scheduler's
+        pad-to-(bucket × mesh) target."""
+        b = self.batch
+        return ((max(1, int(k)) + b - 1) // b) * b
+
+    def validate(self, batch: int, shape: tuple) -> None:
+        """Raise unless ``[batch, *shape]`` tiles this mesh exactly.
+        The scheduler never trips this (it pads); direct
+        ``launch_ensemble`` callers get told to."""
+        if batch % self.batch != 0:
+            raise ValueError(
+                f"ensemble batch {batch} is not a multiple of the mesh "
+                f"batch extent {self.batch}; pad the scenario list to a "
+                f"multiple (the scheduler's pad-to-(bucket × mesh) does "
+                "this with inert zero scenarios)")
+        if shape[0] % self.space != 0:
+            raise ValueError(
+                f"grid rows {shape[0]} not divisible by the mesh space "
+                f"extent {self.space} (XLA tiled sharding)")
+
+    def place_values(self, values: dict) -> dict:
+        """Scatter the ``[B, H, W]`` SoA channels onto the mesh."""
+        sh = self.value_sharding()
+        return {k: put_global(v, sh) for k, v in values.items()}
+
+    def place_lanes(self, lanes):
+        """Scatter a ``[B, F]`` (or ``[B]``) lane array onto the mesh."""
+        return put_global(lanes, self.lane_sharding())
+
+    def totals_view(self, values: dict) -> dict:
+        """The stat/conservation reduction view of a placed ``[B,H,W]``
+        batch. With the space axis cut, a lane's f64 total would lower
+        as a cross-device tree sum whose rounding ORDER differs from
+        the single-device reduction — an ulp off the serial path, which
+        breaks the bitwise-at-f64 stat contract. Reshard to batch-only
+        (rows whole again per lane) first, so every lane reduces in one
+        device's row-major order. Batch-only meshes (space == 1) are
+        already in that order and pass through untouched."""
+        if self.space == 1:
+            return values
+        sh = NamedSharding(self.mesh, P(BATCH_AXIS, None, None))
+        return {k: jax.device_put(v, sh) for k, v in values.items()}
+
+
+MeshSpec = Union[None, int, Sequence[int], EnsembleMesh]
+
+
+def make_ensemble_mesh(batch: Optional[int] = None, space: int = 1,
+                       devices: Optional[Sequence] = None) -> EnsembleMesh:
+    """Build a ``(batch, space)`` ensemble mesh over the first
+    ``batch * space`` available devices (honoring a pinned default
+    device, like ``parallel.mesh``). ``batch=None`` takes every device
+    the space extent leaves over."""
+    devs = _devices(devices)
+    space = max(1, int(space))
+    if batch is None:
+        batch = max(1, len(devs) // space)
+    batch = max(1, int(batch))
+    n = batch * space
+    if n > len(devs):
+        raise ValueError(
+            f"ensemble mesh {batch}x{space} needs {n} devices, "
+            f"have {len(devs)}")
+    grid = np.array(devs[:n]).reshape(batch, space)
+    return EnsembleMesh(Mesh(grid, (BATCH_AXIS, SPACE_AXIS)))
+
+
+def resolve_ensemble_mesh(spec: MeshSpec) -> Optional[EnsembleMesh]:
+    """The one place a wire/CLI/config mesh spec becomes a concrete
+    mesh: ``None`` stays None, an ``EnsembleMesh`` passes through, an
+    int is a batch extent, a ``(batch, space)`` pair is both extents.
+    Ints/pairs resolve against the LOCAL process's devices — that is
+    what lets the spec cross the member wire (a child process builds
+    the mesh from its own, possibly ``member_env``-pinned, device
+    set)."""
+    if spec is None or isinstance(spec, EnsembleMesh):
+        return spec
+    if isinstance(spec, int):
+        return make_ensemble_mesh(batch=spec)
+    b, s = spec
+    return make_ensemble_mesh(batch=int(b), space=int(s))

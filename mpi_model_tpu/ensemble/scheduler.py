@@ -191,9 +191,11 @@ class EnsembleScheduler:
                  windows: int = 1, donate: bool = False,
                  inline_dispatch: bool = True,
                  compile_cache: Optional[str] = "auto",
-                 service_id: Optional[str] = None):
+                 service_id: Optional[str] = None,
+                 mesh=None):
         from ..utils.compile_cache import (configure_compile_cache,
                                            resolve_compile_cache)
+        from .mesh import resolve_ensemble_mesh
 
         if retry not in ("none", "solo"):
             raise ValueError(
@@ -218,8 +220,15 @@ class EnsembleScheduler:
         #: ("auto" default → the machine default; None = disabled)
         self.compile_cache = configure_compile_cache(
             resolve_compile_cache(compile_cache))
+        #: the (batch, space) device mesh every dispatch shards over
+        #: (None = single device). Accepts an EnsembleMesh, a batch
+        #: extent int, or a (batch, space) pair — the int/pair forms
+        #: are what cross the member wire and resolve against the
+        #: local (possibly member_env-pinned) device set.
+        self.mesh = resolve_ensemble_mesh(mesh)
         self.executor = EnsembleExecutor(impl=impl, substeps=substeps,
-                                         compute_dtype=compute_dtype)
+                                         compute_dtype=compute_dtype,
+                                         mesh=self.mesh)
         self.check_conservation = check_conservation
         self.tolerance = tolerance
         self.rtol = rtol
@@ -479,6 +488,13 @@ class EnsembleScheduler:
         else:
             del self._queues[key]
         bucket = next(b for b in self.buckets if b >= k)
+        if self.mesh is not None:
+            # pad-to-(bucket × mesh): the dispatch size must tile the
+            # mesh batch extent, so round the bucket up to a multiple.
+            # Occupancy/padding-waste accounting stays honest — it is
+            # computed against THIS bucket, so mesh padding shows up as
+            # waste instead of being hidden in a pre-rounded bucket.
+            bucket = self.mesh.round_up(bucket)
         return items, bucket
 
     def pump(self, force: bool = False) -> int:
@@ -977,14 +993,18 @@ class EnsembleScheduler:
         log stays reconcilable with the ``dispatches``/``solo_retries``
         counters."""
         self.counter.bump("solo_retries")
+        # a solo retry still tiles the mesh: pad-to-(bucket × mesh)
+        # applies to the smallest bucket exactly like a pumped dispatch
+        solo_bucket = (self.buckets[0] if self.mesh is None
+                       else self.mesh.round_up(self.buckets[0]))
         results, whole_err, cache_hit, wall = self._execute_batch(
-            [it], self.buckets[0])
+            [it], solo_bucket)
         err = whole_err
         if err is None and isinstance(results[0], Exception):
             err = results[0]
         entry = {
-            "bucket": self.buckets[0], "count": 1,
-            "occupancy": 1 / self.buckets[0], "steps": it.steps,
+            "bucket": solo_bucket, "count": 1,
+            "occupancy": 1 / solo_bucket, "steps": it.steps,
             "tickets": [it.ticket], "cache_hit": cache_hit,
             "wall_s": wall, "solo_retry": True,
             "outcome": "recovered" if err is None else "quarantined",
@@ -1077,7 +1097,8 @@ class EnsembleScheduler:
             self._impl_fault_count = 0
             self.executor = EnsembleExecutor(
                 impl=nxt, substeps=self.executor.substeps,
-                compute_dtype=self.executor.compute_dtype)
+                compute_dtype=self.executor.compute_dtype,
+                mesh=self.mesh)
             # mid-fall: pause intake until a dispatch completes clean
             self.intake_gated = True
         warnings.warn(
@@ -1100,6 +1121,10 @@ class EnsembleScheduler:
                 "impl": self.executor.impl,
                 "substeps": self.executor.substeps,
                 "buckets": list(self.buckets),
+                "mesh": (None if self.mesh is None else
+                         {"batch": self.mesh.batch,
+                          "space": self.mesh.space,
+                          "devices": self.mesh.devices}),
                 "retry": self.retry,
                 "retry_budget": self.retry_budget,
                 "ticket_deadline_s": self.ticket_deadline_s,

@@ -109,7 +109,8 @@ class EnsembleService:
                  retry_budget: Optional[int] = None,
                  windows: int = 1, donate: bool = False,
                  compile_cache: Optional[str] = "auto",
-                 service_id: Optional[str] = None):
+                 service_id: Optional[str] = None,
+                 mesh=None):
         self.model = model
         self.default_steps = (model.num_steps if steps is None
                               else int(steps))
@@ -127,7 +128,8 @@ class EnsembleService:
             ticket_deadline_s=ticket_deadline_s,
             retry_budget=retry_budget,
             windows=windows, donate=donate,
-            compile_cache=compile_cache, service_id=service_id)
+            compile_cache=compile_cache, service_id=service_id,
+            mesh=mesh)
         #: the persistent-cache dir actually armed (None = disabled or
         #: unsupported by this jax — the service still serves)
         self.compile_cache = self.scheduler.compile_cache
@@ -214,7 +216,8 @@ class AsyncEnsembleService:
                  service_id: Optional[str] = None,
                  residency_budget: Optional[int] = None,
                  hibernate_dir: Optional[str] = None,
-                 hibernate_budget: Optional[int] = None):
+                 hibernate_budget: Optional[int] = None,
+                 mesh=None):
         if max_queue < 1:
             raise ValueError(f"max_queue={max_queue} must be >= 1")
         if (residency_budget is None) != (hibernate_dir is None):
@@ -241,7 +244,7 @@ class AsyncEnsembleService:
             retry_budget=retry_budget,
             windows=windows, donate=donate,
             inline_dispatch=False, compile_cache=compile_cache,
-            service_id=service_id)
+            service_id=service_id, mesh=mesh)
         self.compile_cache = self.scheduler.compile_cache
         self._clock = clock
         #: ISSUE 14 — capacity-aware paging: with a residency budget

@@ -414,7 +414,8 @@ def test_contracts_cover_all_registered_impls():
     ir = {f"ir_{m}_{i}" for m in ("gray_scott", "sir", "predator_prey")
           for i in ("xla", "composed", "active")} | {"ir_diffusion_xla"}
     assert set(CONTRACTS) == {"dense", "composed", "active", "ensemble",
-                              "active_fused", "active_fused_runner"} | ir
+                              "ensemble_mesh", "active_fused",
+                              "active_fused_runner"} | ir
 
 
 def test_jaxpr_audit_dense_golden():

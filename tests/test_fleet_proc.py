@@ -662,6 +662,71 @@ def test_real_process_results_bitwise_equal_inproc():
             np.asarray(want[i].values["value"]))
 
 
+# -- member_env device pinning + the mesh wire spec (ISSUE 16) ----------------
+
+def test_mesh_spec_crosses_the_wire_loopback():
+    """The ``(batch, space)`` mesh spec is a member KWARG: it crosses
+    the wire as plain extents and the member resolves it against its
+    OWN device set — served results stay bitwise-equal to the meshless
+    inproc fleet, and the member's stats cut reports the mesh."""
+    model = scen_model()
+    spaces = [scen_space(i) for i in range(4)]
+    inproc = FleetSupervisor(model, services=1, steps=4, start=False)
+    want = [inproc.result(inproc.submit(s))[0] for s in spaces]
+    inproc.stop()
+    fleet = proc_fleet(model, services=1, mesh=2)
+    tp = [fleet.submit(s) for s in spaces]
+    got = [fleet.result(t, timeout=300)[0] for t in tp]
+    st = fleet.stats()
+    fleet.stop()
+    for i in range(4):
+        np.testing.assert_array_equal(
+            np.asarray(got[i].values["value"]),
+            np.asarray(want[i].values["value"]))
+    assert st["services"][0]["mesh"] == {
+        "batch": 2, "space": 1, "devices": 2}
+
+
+@pytest.mark.slow
+def test_member_env_pins_each_real_members_device_set():
+    """ISSUE 16 satellite: two REAL spawned members with DISJOINT
+    device-visibility envs (the CPU rig's pin is the forced host
+    device count; silicon uses CUDA_VISIBLE_DEVICES/TPU_VISIBLE_CHIPS)
+    — each child's telemetry must report exactly the device set its
+    slot's pin allows, while the fleet serves correctly through both."""
+    model = scen_model()
+    spaces = [scen_space(i, dtype=jnp.float64) for i in range(4)]
+    inproc = FleetSupervisor(model, services=2, steps=4, start=False)
+    want = [inproc.result(inproc.submit(s))[0] for s in spaces]
+    inproc.stop()
+    fleet = FleetSupervisor(
+        model, services=2, steps=4, start=True,
+        member_transport="process",
+        heartbeat_deadline_s=30.0, rpc_deadline_s=120.0,
+        member_env=[
+            {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+            {"XLA_FLAGS": "--xla_force_host_platform_device_count=3"},
+        ])
+    try:
+        # backend telemetry rides the heartbeat cut — wait for both
+        # children's first beats to land
+        assert _wait_until(lambda: all(
+            s.get("backend") for s in fleet.stats()["services"]))
+        by_slot = {s["slot"]: s["backend"]
+                   for s in fleet.stats()["services"]}
+        assert by_slot[0]["platform"] == "cpu"
+        assert by_slot[0]["device_count"] == 2   # slot 0's pin
+        assert by_slot[1]["device_count"] == 3   # slot 1's pin
+        tp = [fleet.submit(s) for s in spaces]
+        got = [fleet.result(t, timeout=300)[0] for t in tp]
+    finally:
+        fleet.stop()
+    for i in range(4):
+        np.testing.assert_array_equal(
+            np.asarray(got[i].values["value"]),
+            np.asarray(want[i].values["value"]))
+
+
 # -- scenario tiering across the wire (ISSUE 14) ------------------------------
 
 def test_tiering_pages_and_wakes_across_the_wire_bitwise():
