@@ -9,9 +9,12 @@ Quick use::
 
 Library surface: ``run_astlint`` / ``lint_source`` (layer 1, pure AST,
 no jax import), ``run_jaxpr_audit`` (layer 2, abstract traces of the
-four registered step impls), ``RULES``/``Severity``/``Finding`` from
-the registry. Suppress a finding in source with
-``# analysis: ignore[rule-id] — reason``.
+four registered step impls), ``run_concurrency_audit`` (layer 3,
+whole-program lock/phase audit), ``run_protocol_audit`` /
+``lint_protocol_source`` (layer 4, journal/wire vocabulary conformance
+against the declared lifecycle machines),
+``RULES``/``Severity``/``Finding`` from the registry. Suppress a
+finding in source with ``# analysis: ignore[rule-id] — reason``.
 """
 
 from .registry import (RULES, Finding, Pragma, Rule,  # noqa: F401
@@ -21,13 +24,17 @@ from .astlint import (audit_test_module, iter_py_files,  # noqa: F401
 from .concurrency import (SCOPE_CONCURRENCY,  # noqa: F401
                           lint_concurrency_source, run_concurrency_audit,
                           static_lock_graph)
+from .protocol import (SCOPE_PROTOCOL,  # noqa: F401
+                       lint_protocol_source, run_protocol_audit)
 
 __all__ = [
     "RULES", "Finding", "Pragma", "Rule", "Severity", "collect_pragmas",
     "rule", "audit_test_module", "iter_py_files", "lint_file",
     "lint_source", "parse_module", "run_astlint", "run_jaxpr_audit",
     "SCOPE_CONCURRENCY", "lint_concurrency_source",
-    "run_concurrency_audit", "static_lock_graph", "main",
+    "run_concurrency_audit", "static_lock_graph",
+    "SCOPE_PROTOCOL", "lint_protocol_source", "run_protocol_audit",
+    "main",
 ]
 
 

@@ -1,11 +1,11 @@
 """``python -m mpi_model_tpu.analysis`` — run the static-analysis
 gate over the repo.
 
-Default mode runs the AST lint and gates on ERROR-severity findings.
-``--strict`` is the PR bar (what the tier-1 test runs): WARNINGs gate
-too, and the jaxpr contract audit traces all four registered step
-impls. Exit status 0 means zero unsuppressed findings at the selected
-bar.
+Default mode runs the AST lint, the concurrency audit and the protocol
+audit and gates on ERROR-severity findings. ``--strict`` is the PR bar
+(what the tier-1 test runs): WARNINGs gate too, and the jaxpr contract
+audit traces all four registered step impls. Exit status 0 means zero
+unsuppressed findings at the selected bar.
 """
 
 from __future__ import annotations
@@ -15,9 +15,10 @@ import json
 import sys
 from pathlib import Path
 
-from .registry import RULES, Severity
+from .registry import RULES, SCOPE_ENGINE, Severity
 from .astlint import run_astlint
 from .concurrency import SCOPE_CONCURRENCY, run_concurrency_audit
+from .protocol import SCOPE_PROTOCOL, run_protocol_audit
 # registering the jaxpr contract rules is import-time cheap (jax itself
 # loads lazily inside the audit) and makes --rule/--list-rules see the
 # full rule table
@@ -58,20 +59,40 @@ def main(argv=None) -> int:
             print(f"{r.name:18} {r.severity!s:8} {r.scope:8} {r.doc}")
         return 0
 
-    ast_rules = jaxpr_rules = conc_rules = None
+    ast_rules = jaxpr_rules = conc_rules = proto_rules = None
     if args.rules:
         unknown = [r for r in args.rules if r not in RULES]
         if unknown:
-            print(f"unknown rule id(s): {', '.join(unknown)}",
-                  file=sys.stderr)
+            import difflib
+
+            for u in unknown:
+                hint = difflib.get_close_matches(u, RULES, n=1)
+                print(f"unknown rule id: {u!r}"
+                      + (f" — did you mean {hint[0]!r}?" if hint
+                         else " (see --list-rules)"),
+                      file=sys.stderr)
             return 2
         ast_rules = [r for r in args.rules
                      if RULES[r].scope not in (SCOPE_JAXPR,
-                                               SCOPE_CONCURRENCY)]
+                                               SCOPE_CONCURRENCY,
+                                               SCOPE_PROTOCOL,
+                                               SCOPE_ENGINE)]
         jaxpr_rules = [r for r in args.rules
                        if RULES[r].scope == SCOPE_JAXPR]
         conc_rules = [r for r in args.rules
                       if RULES[r].scope == SCOPE_CONCURRENCY]
+        proto_rules = [r for r in args.rules
+                       if RULES[r].scope == SCOPE_PROTOCOL]
+        if not (ast_rules or jaxpr_rules or conc_rules or proto_rules):
+            # engine-scope rules (bare-pragma, parse-error) are
+            # SYNTHESIZED alongside real checks — selecting only them
+            # would scan nothing and report a hollow pass
+            print("rule selection contains only engine-synthesized "
+                  f"rule(s) ({', '.join(args.rules)}) — they fire "
+                  "alongside real checks and cannot run alone; add a "
+                  "checkable rule id or drop --rule",
+                  file=sys.stderr)
+            return 2
 
     root = _repo_root()
     if args.paths:
@@ -100,6 +121,19 @@ def main(argv=None) -> int:
                            for w in wanted
                            for rp in (Path(f.path).resolve(),))]
         findings.extend(conc)
+    if proto_rules or not args.rules:
+        # layer 4 is also whole-program: writer/reader pairs span
+        # modules, so the audit always extracts from the full package
+        # and path selections only filter the report
+        proto = run_protocol_audit(
+            rules=proto_rules, rel_to=None if args.paths else rel_to)
+        if args.paths:
+            wanted = [Path(p).resolve() for p in args.paths]
+            proto = [f for f in proto
+                     if any(rp == w or w in rp.parents
+                            for w in wanted
+                            for rp in (Path(f.path).resolve(),))]
+        findings.extend(proto)
     run_audit = (jaxpr_rules
                  or (args.strict and not args.no_jaxpr and not args.rules))
     if run_audit:
@@ -115,11 +149,20 @@ def main(argv=None) -> int:
     suppressed = [f for f in findings if f.suppressed]
 
     if args.as_json:
+        def enrich(f):
+            # every JSON finding carries its rule's contract and the
+            # remedy inline — a CI annotation needs no registry lookup
+            d = f.to_json()
+            r = RULES.get(f.rule)
+            d["rule_doc"] = r.doc if r else ""
+            d["fix_hint"] = r.fix_hint if r else ""
+            return d
+
         print(json.dumps({
             "strict": args.strict,
-            "blocking": [f.to_json() for f in blocking],
-            "advisory": [f.to_json() for f in advisory],
-            "suppressed": [f.to_json() for f in suppressed],
+            "blocking": [enrich(f) for f in blocking],
+            "advisory": [enrich(f) for f in advisory],
+            "suppressed": [enrich(f) for f in suppressed],
         }, indent=2))
     else:
         for f in blocking:

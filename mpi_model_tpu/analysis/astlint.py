@@ -169,7 +169,10 @@ def parse_module(source: str, path: str = "<string>") -> ModuleCtx:
 @rule("broad-except", Severity.ERROR,
       "`except Exception`/bare `except` hides tracer leaks and dtype "
       "bugs; only pragma'd supervisor boundaries may catch broadly "
-      "(cleanup handlers ending in a bare `raise` are exempt)")
+      "(cleanup handlers ending in a bare `raise` are exempt)",
+      fix_hint="narrow to the exceptions the handler can actually "
+      "recover from, or pragma the supervisor boundary with its "
+      "reason")
 def check_broad_except(ctx: ModuleCtx):
     def is_broad(t) -> bool:
         if t is None:
@@ -195,7 +198,9 @@ def check_broad_except(ctx: ModuleCtx):
 
 
 @rule("mutable-default", Severity.ERROR,
-      "mutable default arguments ([] / {} / set()) alias across calls")
+      "mutable default arguments ([] / {} / set()) alias across calls",
+      fix_hint="default to None and create the container inside the "
+      "function body")
 def check_mutable_default(ctx: ModuleCtx):
     def is_mutable(d) -> bool:
         if isinstance(d, (ast.List, ast.Dict, ast.Set)):
@@ -227,7 +232,9 @@ NUMPY_ALIASES = {"np", "numpy", "onp"}
 @rule("host-sync", Severity.ERROR,
       "host syncs (`block_until_ready`, `np.asarray`, `.item()`) inside "
       "a traced/step-builder function stall the device pipeline or leak "
-      "tracers at trace time")
+      "tracers at trace time",
+      fix_hint="return the traced value and sync at the caller (outside "
+      "jit), or move the call out of the traced scope")
 def check_host_sync(ctx: ModuleCtx):
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
@@ -277,7 +284,9 @@ def _has_float_literal(node: ast.AST) -> Optional[ast.Constant]:
 @rule("dtype-drift", Severity.WARNING,
       "a bare float literal in a jnp constructor takes the ambient-x64 "
       "default dtype, not the space dtype — pin `dtype=`",
-      scope=SCOPE_PACKAGE)
+      scope=SCOPE_PACKAGE,
+      fix_hint="pass dtype= explicitly (the space dtype, usually from "
+      "the config)")
 def check_dtype_drift(ctx: ModuleCtx):
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
@@ -339,7 +348,9 @@ def _branch_on_traced(test: ast.AST, params: set[str]) -> Optional[str]:
 @rule("traced-branch", Severity.WARNING,
       "a Python `if`/`while` on a traced value raises "
       "ConcretizationTypeError at trace time (or silently bakes one "
-      "branch); use lax.cond/jnp.where")
+      "branch); use lax.cond/jnp.where",
+      fix_hint="rewrite the branch as lax.cond/lax.while_loop or a "
+      "jnp.where select")
 def check_traced_branch(ctx: ModuleCtx):
     for fn in ctx.traced_scopes:
         if isinstance(fn, ast.Lambda):
@@ -514,7 +525,9 @@ def _unmarked_heavy_tests(ctx: ModuleCtx) -> list[ast.AST]:
 @rule("heavy-test", Severity.ERROR,
       "tests that spawn subprocesses, run dryrun rigs, or build >= "
       "2048² grids must carry @pytest.mark.slow (tier-1 870 s wall)",
-      scope=SCOPE_TESTS)
+      scope=SCOPE_TESTS,
+      fix_hint="decorate the test with @pytest.mark.slow (or shrink the "
+      "grid below 2048²)")
 def check_heavy_test(ctx: ModuleCtx):
     for node in _unmarked_heavy_tests(ctx):
         yield Finding(
@@ -580,7 +593,9 @@ def _save_boundary_module(ctx: ModuleCtx) -> bool:
       "checkpoint writes outside the supervisor/flush boundaries must "
       "go through CheckpointManager's checksum-writing path — raw "
       "writer calls can reintroduce unverifiable checkpoints",
-      scope=SCOPE_PACKAGE)
+      scope=SCOPE_PACKAGE,
+      fix_hint="route the write through CheckpointManager.save so the "
+      "checksum sidecar is written atomically")
 def check_naked_save(ctx: ModuleCtx):
     if _save_boundary_module(ctx):
         return
@@ -645,7 +660,10 @@ def _transport_boundary_module(ctx: ModuleCtx) -> bool:
       "raw socket/subprocess use outside the ensemble wire boundary — "
       "bytes crossing a process edge must ride the CRC-framed, "
       "deadline-bounded codec (ensemble/wire.py, member_proc.py)",
-      scope=SCOPE_PACKAGE)
+      scope=SCOPE_PACKAGE,
+      fix_hint="send the bytes through the wire codec (ensemble/wire.py) "
+      "or add the module to the transport boundary with a "
+      "reasoned pragma")
 def check_raw_transport(ctx: ModuleCtx):
     if _transport_boundary_module(ctx):
         return
@@ -715,7 +733,9 @@ def _under_lock_with(ctx: ModuleCtx, node: ast.AST,
       "(anywhere in the class body) must write self.* state inside "
       "`with self.<lock>:` (escapes: __init__, *_locked methods, "
       "pragma) — an unlocked write races the pump thread",
-      scope=SCOPE_PACKAGE)
+      scope=SCOPE_PACKAGE,
+      fix_hint="wrap the write in `with self.<lock>:` or rename the "
+      "method *_locked and call it under the lock")
 def check_unguarded_shared_mutation(ctx: ModuleCtx):
     if not _module_is_threaded(ctx.tree):
         return
@@ -792,7 +812,9 @@ def _time_module_imports(tree: ast.Module) -> tuple[set[str], set[str]]:
       "`time.sleep`/`time.time` in tests/ couples the suite to the "
       "wall clock — drive the injectable clock instead (pragma a "
       "genuine wall dependency with its reason)",
-      scope=SCOPE_TESTS)
+      scope=SCOPE_TESTS,
+      fix_hint="drive the injectable clock (resilience.clock) instead of "
+      "time.*")
 def check_wall_clock_in_test(ctx: ModuleCtx):
     # only calls through an ACTUAL time import count: in a module that
     # never imports time, a name `time` is a local binding (e.g. a
@@ -871,7 +893,9 @@ def _serving_module(ctx: ModuleCtx) -> bool:
       "serving/ensemble modules — new timing should flow through "
       "tracing spans or the metrics LatencyReservoir so it lands on "
       "the telemetry plane (pragma a reasoned site)",
-      scope=SCOPE_PACKAGE)
+      scope=SCOPE_PACKAGE,
+      fix_hint="time the section with a tracing span or feed the sample "
+      "into metrics.LatencyReservoir")
 def check_naked_timer(ctx: ModuleCtx):
     if not _serving_module(ctx):
         return
@@ -1007,7 +1031,9 @@ def _physics_boundary_module(ctx: ModuleCtx) -> bool:
       "transport-shaped arithmetic (stencil redistribution helpers) "
       "outside ops/ and ir/ lowerings — new physics belongs in IR "
       "terms lowered once, not in another hand-mirrored step",
-      scope=SCOPE_PACKAGE)
+      scope=SCOPE_PACKAGE,
+      fix_hint="express the stencil as a Flow IR term and lower it in "
+      "ir.lower")
 def check_hardcoded_physics(ctx: ModuleCtx):
     if _physics_boundary_module(ctx):
         return
@@ -1023,3 +1049,107 @@ def check_hardcoded_physics(ctx: ModuleCtx):
                 "arithmetic belongs in an IR term's registered lowering "
                 "(ir.lower) so every engine serves it — pragma a "
                 "retained legacy path with its reason")
+
+
+# -- journal-kind-literal rule (ISSUE 19 satellite) ---------------------------
+# The lifecycle refactor moved every journal record kind behind the
+# constants in ensemble/lifecycle.py; this rule is what keeps them
+# there. A raw string literal in an append or dispatch position
+# compiles fine, runs fine, and silently re-forks the vocabulary the
+# day it drifts from the declaration — exactly the failure class the
+# layer-4 protocol audit exists for, caught here at the single-module
+# level where the fix is one import away.
+
+#: the helpers whose first argument IS a record kind (shared naming
+#: with analysis.protocol's extraction)
+_JOURNAL_APPEND_HELPERS = ("_journal_append_locked", "_append_locked")
+
+_JOURNAL_VOCAB: Optional[frozenset] = None
+
+
+def _journal_vocab() -> frozenset:
+    """The declared record-kind strings, read off
+    ``ensemble/lifecycle.py``'s AST (uppercase module-level string
+    constants; ``INITIAL`` is a state, not a kind) — parsed, not
+    imported, so the lint never executes package code."""
+    global _JOURNAL_VOCAB
+    if _JOURNAL_VOCAB is None:
+        path = (Path(__file__).resolve().parent.parent
+                / "ensemble" / "lifecycle.py")
+        out = set()
+        try:
+            tree = ast.parse(path.read_text())
+        except (OSError, SyntaxError):  # pragma: no cover - lifecycle
+            # unreadable: the rule degrades to append-literals only
+            tree = ast.Module(body=[], type_ignores=[])
+        for node in tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id.isupper()
+                    and node.targets[0].id != "INITIAL"
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                out.add(node.value.value)
+        _JOURNAL_VOCAB = frozenset(out)
+    return _JOURNAL_VOCAB
+
+
+def _lifecycle_module(ctx: ModuleCtx) -> bool:
+    parts = ctx.resolved_parts
+    return (len(parts) >= 2 and parts[-2] == "ensemble"
+            and parts[-1] == "lifecycle.py")
+
+
+@rule("journal-kind-literal", Severity.ERROR,
+      "a raw record-kind string literal in a journal append or "
+      "dispatch position outside ensemble/lifecycle.py — the declared "
+      "constants are the vocabulary's single spelling; a literal "
+      "re-forks it invisibly",
+      scope=SCOPE_PACKAGE,
+      fix_hint="import the kind constant from ensemble.lifecycle "
+               "(SUBMIT, SERVED, …) and use it instead of the literal")
+def check_journal_kind_literal(ctx: ModuleCtx):
+    if _lifecycle_module(ctx):
+        return
+    vocab = _journal_vocab()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            is_append = (
+                (isinstance(fn, ast.Name)
+                 and fn.id in _JOURNAL_APPEND_HELPERS)
+                or (isinstance(fn, ast.Attribute)
+                    and (fn.attr in _JOURNAL_APPEND_HELPERS
+                         or (fn.attr == "append"
+                             and "journal" in
+                             (_dotted_last(fn.value) or "").lower()))))
+            if (is_append and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                yield Finding(
+                    "journal-kind-literal", Severity.ERROR, ctx.path,
+                    node.lineno,
+                    f"append site spells record kind "
+                    f"{node.args[0].value!r} as a raw literal — use "
+                    "the ensemble.lifecycle constant")
+        elif isinstance(node, ast.Compare):
+            left = node.left
+            if not (isinstance(left, ast.Attribute)
+                    and left.attr == "kind"):
+                continue
+            lits = [c.value for c in node.comparators
+                    if isinstance(c, ast.Constant)
+                    and isinstance(c.value, str)]
+            for c in node.comparators:
+                if isinstance(c, (ast.Tuple, ast.List, ast.Set)):
+                    lits.extend(e.value for e in c.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str))
+            hits = sorted(set(lits) & vocab)
+            if hits:
+                yield Finding(
+                    "journal-kind-literal", Severity.ERROR, ctx.path,
+                    node.lineno,
+                    f"dispatch compares .kind against raw literal(s) "
+                    f"{', '.join(map(repr, hits))} — use the "
+                    "ensemble.lifecycle constants")

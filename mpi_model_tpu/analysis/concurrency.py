@@ -85,29 +85,40 @@ from .registry import (RULES, Finding, Rule, Severity, apply_pragmas,
 SCOPE_CONCURRENCY = "concurrency"
 
 
-def _register(name: str, severity: Severity, doc: str) -> None:
+def _register(name: str, severity: Severity, doc: str,
+              fix_hint: str = "") -> None:
     if name not in RULES:
         RULES[name] = Rule(name, severity, doc,
-                           check=lambda ctx: (), scope=SCOPE_CONCURRENCY)
+                           check=lambda ctx: (), scope=SCOPE_CONCURRENCY,
+                           fix_hint=fix_hint)
 
 
 _register("lock-order", Severity.ERROR,
           "a cycle in the inter-procedural lock-acquisition graph (or a "
           "non-reentrant lock nested under itself) is a potential "
           "deadlock — keep every path acquiring locks in one global "
-          "order")
+          "order",
+          fix_hint="acquire the locks in the documented global order "
+                   "(or release the outer lock before taking the "
+                   "inner one)")
 _register("blocking-under-lock", Severity.WARNING,
           "device work (jnp dispatch/device_get/block_until_ready), "
           "file I/O, Thread.join or sleeps while holding a lock stall "
           "every thread contending for it — move the work outside the "
-          "lock or pragma the reasoned exception")
+          "lock or pragma the reasoned exception",
+          fix_hint="snapshot state under the lock, release it, then do "
+                   "the blocking work on the snapshot")
 _register("lock-leak", Severity.ERROR,
           "bare .acquire() outside with/try-finally leaks the lock on "
-          "any exception between acquire and release")
+          "any exception between acquire and release",
+          fix_hint="use `with lock:` (or wrap acquire/release in "
+                   "try/finally)")
 _register("thread-shared-without-lock", Severity.WARNING,
           "an attribute written on the pump/supervisor thread and read "
           "from the client surface with no common lock is a torn-read "
-          "race (the read-side twin of unguarded-shared-mutation)")
+          "race (the read-side twin of unguarded-shared-mutation)",
+          fix_hint="read the attribute under the same lock the writer "
+                   "holds (a *_locked accessor keeps it explicit)")
 
 
 # -- the shared lock model (layer 1's unguarded-shared-mutation re-fronts

@@ -72,38 +72,54 @@ FORBIDDEN_PRIMITIVE_PARTS = ("callback", "debug", "print", "infeed",
                              "outfeed")
 
 
-def _register(name: str, doc: str) -> None:
+def _register(name: str, doc: str, fix_hint: str = "") -> None:
     if name not in RULES:
         RULES[name] = Rule(name, Severity.ERROR, doc,
-                           check=lambda ctx: (), scope=SCOPE_JAXPR)
+                           check=lambda ctx: (), scope=SCOPE_JAXPR,
+                           fix_hint=fix_hint)
 
 
 _register("jaxpr-dtype",
           "every step output dtype must equal the space dtype (no "
-          "silent f32/f64 leaks past the oracle gates)")
+          "silent f32/f64 leaks past the oracle gates)",
+          fix_hint="cast with .astype(space.dtype) at the leak site "
+                   "(usually a bare literal or np constant)")
 _register("jaxpr-callback",
-          "no callback/debug/print primitives inside a traced step")
+          "no callback/debug/print primitives inside a traced step",
+          fix_hint="hoist the debug I/O out of the jitted function — "
+                   "inspect outputs at the caller instead")
 _register("jaxpr-consts",
           "no O(grid) constant baked into a step jaxpr; total consts "
-          "within budget (recompile/memory bloat)")
+          "within budget (recompile/memory bloat)",
+          fix_hint="pass the array as a traced argument (donate if "
+                   "large) instead of closing over it")
 _register("jaxpr-halo",
           "stencil radius must fit the halo depth the impl's sharded "
-          "configuration declares")
+          "configuration declares",
+          fix_hint="widen halo_depth in the impl's sharding config or "
+                   "shrink the stencil radius")
 _register("jaxpr-term-registry",
           "every Flow IR term kind has exactly one registered, audited "
           "lowering, and it lives in ir.lower — no impl-private term "
-          "branches")
+          "branches",
+          fix_hint="move the term's lowering into ir.lower and register "
+                   "it there; delete the impl-local branch")
 _register("jaxpr-fused-flags",
           "the fused active runner's per-pass loop must carry no "
           "reduction at tile size or larger outside the kernel — "
           "activity flags come out of the Pallas pass, never a "
-          "separate per-step reduction")
+          "separate per-step reduction",
+          fix_hint="emit the activity flag from the Pallas kernel's "
+                   "accumulator output rather than reducing the field "
+                   "again outside it")
 _register("jaxpr-batch-psum",
           "the mesh-sharded ensemble runner's per-scenario stat lanes "
           "must reduce over the space axes only (one f64 reduce_sum "
           "per channel, [B,H,W] -> [B]) — a full-batch or "
           "wrong-dtype reduction would break the batch-sharded "
-          "conservation contract")
+          "conservation contract",
+          fix_hint="reduce with axis=(1, 2) (space only) and cast the "
+                   "accumulator to f64 before the sum")
 
 
 @dataclasses.dataclass

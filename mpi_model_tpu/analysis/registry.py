@@ -87,6 +87,10 @@ class Rule:
     doc: str
     check: Callable
     scope: str = SCOPE_ALL
+    #: one actionable sentence — what to change (or which pragma to
+    #: write) when the rule fires; rides every ``--json`` finding as
+    #: ``fix_hint`` so a CI consumer can surface the remedy inline
+    fix_hint: str = ""
 
 
 #: rule-id → Rule, in registration order (reports keep this order)
@@ -100,16 +104,20 @@ RULES["bare-pragma"] = Rule(
     "bare-pragma", Severity.ERROR,
     "a suppression pragma with no reason (synthesized by the engine "
     "whenever a reasonless pragma actually fires)",
-    check=lambda ctx: (), scope=SCOPE_ENGINE)
+    check=lambda ctx: (), scope=SCOPE_ENGINE,
+    fix_hint="append the justification: `# analysis: ignore[rule] — "
+             "why this is safe`")
 RULES["parse-error"] = Rule(
     "parse-error", Severity.ERROR,
     "a scanned file failed to parse or read (synthesized by the "
     "engine; a broken file cannot be linted and must not pass silently)",
-    check=lambda ctx: (), scope=SCOPE_ENGINE)
+    check=lambda ctx: (), scope=SCOPE_ENGINE,
+    fix_hint="fix the syntax error (or delete the file) — a broken "
+             "module can neither run nor be audited")
 
 
 def rule(name: str, severity: Severity, doc: str,
-         scope: str = SCOPE_ALL) -> Callable:
+         scope: str = SCOPE_ALL, fix_hint: str = "") -> Callable:
     """Register an AST rule::
 
         @rule("broad-except", Severity.ERROR, "…contract…")
@@ -121,7 +129,8 @@ def rule(name: str, severity: Severity, doc: str,
     def deco(fn: Callable) -> Callable:
         if name in RULES:
             raise ValueError(f"duplicate rule id {name!r}")
-        RULES[name] = Rule(name, severity, doc, fn, scope)
+        RULES[name] = Rule(name, severity, doc, fn, scope,
+                           fix_hint=fix_hint)
         return fn
 
     return deco

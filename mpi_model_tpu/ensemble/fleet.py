@@ -76,6 +76,8 @@ from ..utils.tracing import TraceContext, get_tracer
 from .batch import structure_key
 from .journal import (TicketJournal, journal_path, model_from_meta,
                       model_meta, replay, space_from_record, space_payload)
+from .lifecycle import (EXPIRED, MIGRATE, QUARANTINED, READMIT, SERVED,
+                        SHED, SUBMIT, WAKE)
 from .scheduler import TicketExpired, TicketNotMigratable
 from .service import AsyncEnsembleService, ServiceOverloaded
 from .tiering import HibernationError, ScenarioTiering, scenario_nbytes
@@ -574,7 +576,7 @@ class FleetSupervisor:
                 get_recorder().record("shed", service_id=None)
                 depth = sum(m.service.scheduler.pending_count()
                             for m in order)
-                self._journal_append_locked("shed", {
+                self._journal_append_locked(SHED, {
                     "depth": depth,
                     "members": [m.service_id for m in order]})
         if ticket is None:
@@ -601,7 +603,7 @@ class FleetSupervisor:
             # caller still holds its state)
             with self._cv:
                 self._hib_meta.pop(ticket, None)
-                self._journal_append_locked("quarantined", {
+                self._journal_append_locked(QUARANTINED, {
                     "ticket": ticket, "service_id": "hibernated",
                     "steps": n, "error": type(e).__name__,
                     "detail": f"hibernation write failed: {e}"})
@@ -947,9 +949,9 @@ class FleetSupervisor:
                else "recovery")
         try:
             if isinstance(outcome, Exception):
-                kind = ("expired"
+                kind = (EXPIRED
                         if isinstance(outcome, TicketExpired)
-                        else "quarantined")
+                        else QUARANTINED)
                 self._journal_append_locked(kind, {
                     "ticket": ticket, "service_id": sid,
                     "steps": route.steps,
@@ -974,7 +976,7 @@ class FleetSupervisor:
                     "initial_total": dict(report.initial_total),
                     "final_total": dict(report.final_total),
                     "wall_time_s": report.wall_time_s})
-                self._journal_append_locked("served", meta, arrays)
+                self._journal_append_locked(SERVED, meta, arrays)
         finally:
             # the in-memory ledger resolves even if journaling failed
             # in an unforeseen way: a journal failure must never turn
@@ -1202,7 +1204,7 @@ class FleetSupervisor:
                 else:
                     route.member, route.member_ticket = target, new_mt
                     moved = True
-                    self._journal_append_locked("migrate", {
+                    self._journal_append_locked(MIGRATE, {
                         "ticket": ticket, "from": m.service_id,
                         "to": target.service_id, "reason": reason})
             if not moved:
@@ -1237,7 +1239,7 @@ class FleetSupervisor:
                 continue
             route.member, route.member_ticket = target, new_mt
             self.counter.bump("readmitted")
-            self._journal_append_locked("readmit", {
+            self._journal_append_locked(READMIT, {
                 "ticket": ticket, "from": old_sid,
                 "to": target.service_id, "reason": reason})
             return
@@ -1320,7 +1322,7 @@ class FleetSupervisor:
                 self._readmit_locked(ticket, route, reason)
                 continue
             route.member, route.member_ticket = order[0], new_mt
-            self._journal_append_locked("migrate", {
+            self._journal_append_locked(MIGRATE, {
                 "ticket": ticket, "from": m.service_id,
                 "to": order[0].service_id, "reason": reason})
 
@@ -1446,7 +1448,7 @@ class FleetSupervisor:
                 sid = mem.service_id
                 self._wakes_by_member[sid] = \
                     self._wakes_by_member.get(sid, 0) + 1
-                self._journal_append_locked("wake", {
+                self._journal_append_locked(WAKE, {
                     "ticket": ticket, "to": sid})
                 self._cv.notify_all()
                 return True
@@ -1461,7 +1463,7 @@ class FleetSupervisor:
         from ..resilience import FailureEvent
 
         expired = isinstance(err, TicketExpired)
-        kind = "expired" if expired else "quarantined"
+        kind = EXPIRED if expired else QUARANTINED
         err.ticket = ticket
         ev = FailureEvent(
             step=steps, kind="expired" if expired else "hibernation",
@@ -1580,7 +1582,7 @@ class FleetSupervisor:
             "steps": steps, "model": model_meta(model)})
         if trace is not None:
             meta["trace"] = trace.to_meta()
-        self._journal_append_locked("submit", meta, arrays)
+        self._journal_append_locked(SUBMIT, meta, arrays)
 
     # -- autoscaling ---------------------------------------------------------
 
@@ -1655,7 +1657,7 @@ class FleetSupervisor:
             # the trace id rides the submit record (ISSUE 15): the
             # offline timeline joins exported spans through it
             meta["trace"] = route.trace.to_meta()
-        self._journal_append_locked("submit", meta, arrays)
+        self._journal_append_locked(SUBMIT, meta, arrays)
 
     @classmethod
     def recover(cls, journal_dir: str, model, **kwargs
@@ -1686,7 +1688,7 @@ class FleetSupervisor:
             hib = (fleet.tiering.recover(model)
                    if fleet.tiering is not None else {})
             for t, rec in state.terminal.items():
-                if rec.kind == "served":
+                if rec.kind == SERVED:
                     if rec.arrays is None:
                         err: Exception = MemberFailure(
                             f"ticket {t} was served before the restart "
@@ -1710,7 +1712,7 @@ class FleetSupervisor:
                             "recovered_from_journal": True,
                             "service_id": rec.meta.get("service_id")})
                     fleet._resolved[t] = (sp, rep)
-                elif rec.kind == "expired":
+                elif rec.kind == EXPIRED:
                     err = TicketExpired(
                         rec.meta.get("detail",
                                      f"ticket {t} expired before restart"))

@@ -63,7 +63,8 @@ import numpy as np
 
 from ..core.cellular_space import CellularSpace
 from ..models.model import Model
-from ..resilience import inject
+from ..resilience import inject, protocolcheck
+from .lifecycle import FLEET, SHED, SUBMIT, TERMINAL_KINDS
 from .wire import encode_payload, parse_payload
 
 __all__ = [
@@ -83,8 +84,11 @@ __all__ = [
     "TERMINAL_KINDS",
 ]
 
-#: record kinds that RESOLVE a ticket (everything else is attribution)
-TERMINAL_KINDS = ("served", "quarantined", "expired")
+# TERMINAL_KINDS (re-exported above) and the full record vocabulary are
+# DECLARED in ensemble.lifecycle — the single source of truth the
+# protocol auditor (analysis.protocol) and the runtime witness
+# (resilience.protocolcheck) audit writers and readers against. This
+# module only folds what the machine declares.
 
 _MAGIC = b"TJ1 "
 _HEADER_RE = re.compile(rb"^TJ1 ([0-9a-f]{8}) ([0-9a-f]{8})\n$")
@@ -92,8 +96,10 @@ _HEADER_LEN = 22  # b"TJ1 " + 8 hex + b" " + 8 hex + b"\n"
 
 #: the journal file name inside a journal directory (one stream per
 #: fleet; recovery appends to the same file, so the whole history of a
-#: slot — original run + every restart — reads as one ledger)
-JOURNAL_NAME = "tickets.journal"
+#: slot — original run + every restart — reads as one ledger). The
+#: basename is the machine's: it is how the runtime witness maps a live
+#: append stream back to its declared lifecycle.
+JOURNAL_NAME = FLEET.journal_name
 
 
 def journal_path(journal_dir: str) -> str:
@@ -164,6 +170,11 @@ class TicketJournal:
         self._fh.flush()
         idx = self._count
         self._count += 1
+        # the protocol witness observes every durable append (one
+        # global read when disarmed); it fires BEFORE the torn-tail
+        # chaos seam — an injected tear models a crash AFTER this
+        # process already advanced its in-memory state
+        protocolcheck.journal_append(self.path, kind, body)
         inject.journal_torn(self.path, idx, start)
         return idx
 
@@ -258,20 +269,22 @@ def fold_records(records: list, torn: bool) -> JournalState:
     """Fold already-verified records to per-ticket outcomes — the
     in-memory half of :func:`replay`, so callers that already hold the
     record list (the inspection CLI) do not re-read and re-CRC the
-    whole file per derived view."""
+    whole file per derived view. The fold consumes the DECLARED fleet
+    machine (``lifecycle.FLEET``) — what resolves a ticket is whatever
+    the declaration says is terminal, never a literal spelled here."""
     submits: dict = {}
     terminal: dict = {}
     dup: list = []
     shed = 0
     for rec in records:
-        if rec.kind == "submit":
+        if rec.kind == SUBMIT:
             submits[rec.ticket] = rec
-        elif rec.kind in TERMINAL_KINDS:
+        elif FLEET.is_terminal(rec.kind):
             if rec.ticket in terminal:
                 dup.append(rec.ticket)
             else:
                 terminal[rec.ticket] = rec
-        elif rec.kind == "shed":
+        elif rec.kind == SHED:
             shed += 1
     return JournalState(submits=submits, terminal=terminal,
                         duplicate_terminals=dup, shed=shed, torn=torn)

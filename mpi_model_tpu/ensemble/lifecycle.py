@@ -1,0 +1,266 @@
+"""The declared ticket-lifecycle state machine (ISSUE 19 tentpole).
+
+Before this module the ticket protocol — which journal record kinds
+exist, which transitions are legal, which kinds resolve a ticket —
+lived as hand-rolled string literals spread over ``journal.py``'s
+replay fold, ``tiering.py``'s recovery fold and wake ladder,
+``fleet.py``'s append sites and ``obs.postmortem``'s timeline join.
+Every reader re-derived the vocabulary independently, so a drifted
+literal (a kind written that no reader handles, a meta key read that no
+writer stamps) was invisible until a chaos row happened to cross it.
+
+This module is the single source of truth the rest of the package
+consumes:
+
+- **kind constants** (``SUBMIT`` … ``RECLAIM``): every append site and
+  every reader dispatch references these — a raw record-kind string
+  literal outside this module is an ERROR (the ``journal-kind-literal``
+  lint rule);
+- **two machines** (:data:`FLEET`, :data:`TIERING`), one per journal
+  stream, each declaring its states, legal transitions, terminal set
+  and the meta keys each record kind carries;
+- the **FailureEvent kind set** (:data:`EVENT_KINDS`) — every
+  ``FailureEvent(kind=...)`` constructed anywhere must use one of
+  these (the ``event-kind-coverage`` protocol rule);
+- the **universal stamps** (:data:`STAMPED_META`): keys every record
+  carries regardless of kind (``kind`` and ``t_wall`` stamped by
+  ``TicketJournal.append``, ``arrays`` by the payload codec).
+
+Consumers: ``journal.fold_records``/``replay`` fold the fleet stream
+with :data:`FLEET`; ``tiering.ScenarioTiering.recover`` folds the
+lifecycle stream with :data:`TIERING`; ``obs.postmortem`` classifies
+timeline events through both; ``analysis.protocol`` (layer 4) audits
+the whole program's writers and readers against the declarations; and
+``resilience.protocolcheck`` is the runtime witness asserting live
+streams only ever take declared transitions.
+
+Declaring a NEW record kind (the checklist DESIGN.md "Protocol
+analysis" walks through): add the kind constant, add a
+:class:`Transition` to the owning machine (sources, target, the meta
+keys the writer stamps), write the append site through the constant,
+and teach the reader folds only if the kind needs bespoke handling —
+the protocol auditor then proves writer, reader and declaration agree.
+
+IMPORT-LIGHT BY CONTRACT: stdlib only (no numpy/jax), so the obs plane,
+the analysis layer and the runtime witness can all load the machine
+without pulling the serving stack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+__all__ = [
+    "EVENT_KINDS",
+    "EXPIRED",
+    "FLEET",
+    "HIBERNATE",
+    "HIBERNATED",
+    "LifecycleMachine",
+    "MIGRATE",
+    "QUARANTINED",
+    "READMIT",
+    "RECLAIM",
+    "REQUEUE",
+    "SERVED",
+    "SHED",
+    "STAMPED_META",
+    "SUBMIT",
+    "TERMINAL_KINDS",
+    "TIERING",
+    "Transition",
+    "WAKE",
+    "machine_for_journal",
+]
+
+# -- record-kind constants (the only place these strings are spelled) ---------
+
+#: fleet stream — admission/resolution
+SUBMIT = "submit"
+SERVED = "served"
+QUARANTINED = "quarantined"
+EXPIRED = "expired"
+SHED = "shed"
+#: fleet stream — attribution (the ticket moved, nothing resolved)
+READMIT = "readmit"
+MIGRATE = "migrate"
+WAKE = "wake"
+#: tiering stream — the hibernate/wake paging lifecycle
+HIBERNATE = "hibernate"      # intent (written BEFORE the chain write)
+HIBERNATED = "hibernated"    # commit (the chain record verified on disk)
+REQUEUE = "requeue"          # woke, found no placement, back at the head
+RECLAIM = "reclaim"          # chain deleted (resolution or orphan sweep)
+
+#: kinds that RESOLVE a fleet ticket (everything else is attribution)
+TERMINAL_KINDS = (SERVED, QUARANTINED, EXPIRED)
+
+#: meta keys EVERY record carries regardless of kind: ``kind``/``t_wall``
+#: are stamped by ``TicketJournal.append``, ``arrays`` (the per-array
+#: CRC table) by the shared TJ1/TW1 payload codec when state rides along
+STAMPED_META = ("kind", "t_wall", "arrays")
+
+#: every ``resilience.FailureEvent.kind`` the package constructs (the
+#: supervisor docstring's taxonomy, now machine-checked by the
+#: ``event-kind-coverage`` protocol rule)
+EVENT_KINDS = frozenset({
+    "exception",      # the step raised
+    "nonfinite",      # NaN/Inf in the state
+    "conservation",   # the invariant check failed
+    "timeout",        # a dispatch/ticket deadline passed
+    "expired",        # a ticket aged out before serving
+    "member",         # a fleet member died/wedged (fence + re-admit)
+    "hibernation",    # the hibernate/wake paging path failed
+})
+
+
+# -- the machines -------------------------------------------------------------
+
+#: the implicit state of a ticket the stream has not mentioned yet
+INITIAL = "new"
+
+
+@dataclasses.dataclass(frozen=True)
+class Transition:
+    """One declared transition: the journal record ``kind`` that emits
+    it, the states it is legal FROM, the state it lands in, and the
+    meta keys its writer stamps (beyond :data:`STAMPED_META`).
+    ``ticketless`` transitions are stream-level audit records (no
+    per-ticket state — the fleet's ``shed``)."""
+
+    kind: str
+    sources: tuple
+    target: str
+    meta: tuple = ()
+    terminal: bool = False
+    ticketless: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class LifecycleMachine:
+    """One journal stream's declared protocol. ``journal_name`` is the
+    stream's file basename — what maps a live ``TicketJournal`` back to
+    its machine (:func:`machine_for_journal`)."""
+
+    stream: str
+    journal_name: str
+    states: tuple
+    transitions: tuple
+
+    def kinds(self) -> tuple:
+        """Every declared record kind, in declaration order."""
+        return tuple(t.kind for t in self.transitions)
+
+    def terminal_kinds(self) -> tuple:
+        return tuple(t.kind for t in self.transitions if t.terminal)
+
+    def attribution_kinds(self) -> tuple:
+        """Per-ticket kinds that move a ticket without starting or
+        resolving it (what a timeline shows between submit and
+        terminal)."""
+        return tuple(t.kind for t in self.transitions
+                     if not t.terminal and not t.ticketless
+                     and INITIAL not in t.sources)
+
+    def transition(self, kind: str) -> Optional[Transition]:
+        for t in self.transitions:
+            if t.kind == kind:
+                return t
+        return None
+
+    def is_terminal(self, kind: str) -> bool:
+        t = self.transition(kind)
+        return t is not None and t.terminal
+
+    def legal(self, kind: str, state: str) -> bool:
+        """Is ``kind`` a declared transition out of ``state``?"""
+        t = self.transition(kind)
+        return t is not None and (t.ticketless or state in t.sources)
+
+    def meta_keys(self) -> frozenset:
+        """Every declared per-kind meta key plus the universal stamps —
+        the vocabulary the ``journal-meta-drift`` rule checks reader
+        key reads against."""
+        keys = set(STAMPED_META)
+        for t in self.transitions:
+            keys.update(t.meta)
+        return frozenset(keys)
+
+
+#: the fleet ticket journal (``tickets.journal``): one record per
+#: scheduler seam a ticket crosses. A ticket is ``in-flight`` from its
+#: submit (resident OR hibernated — the fleet stream does not
+#: distinguish; the tiering stream does) until exactly one terminal.
+FLEET = LifecycleMachine(
+    stream="fleet",
+    journal_name="tickets.journal",
+    states=(INITIAL, "in-flight", "resolved"),
+    transitions=(
+        Transition(SUBMIT, (INITIAL,), "in-flight",
+                   meta=("ticket", "service_id", "steps", "model",
+                         "trace", "dim_x", "dim_y")),
+        Transition(SERVED, ("in-flight",), "resolved", terminal=True,
+                   meta=("ticket", "service_id", "steps",
+                         "initial_total", "final_total", "wall_time_s",
+                         "dim_x", "dim_y", "recovered_from_journal")),
+        Transition(QUARANTINED, ("in-flight",), "resolved",
+                   terminal=True,
+                   meta=("ticket", "service_id", "steps", "error",
+                         "detail")),
+        Transition(EXPIRED, ("in-flight",), "resolved", terminal=True,
+                   meta=("ticket", "service_id", "steps", "error",
+                         "detail")),
+        Transition(SHED, (), INITIAL, ticketless=True,
+                   meta=("depth", "members")),
+        Transition(MIGRATE, ("in-flight",), "in-flight",
+                   meta=("ticket", "from", "to", "reason")),
+        Transition(READMIT, ("in-flight",), "in-flight",
+                   meta=("ticket", "from", "to", "reason")),
+        Transition(WAKE, ("in-flight",), "in-flight",
+                   meta=("ticket", "to")),
+    ),
+)
+
+#: the hibernation lifecycle journal (``hibernation.journal``): the
+#: intent→commit→wake chain ``ScenarioTiering`` writes around every
+#: paging operation. ``hibernate`` is legal from ``resident`` too
+#: (re-hibernation of a woken scenario); ``wake`` is legal ONLY from
+#: the committed state — a wake whose intent never committed is the
+#: torn-hibernation crash shape, and the runtime witness flags it on a
+#: LIVE stream (recovery resolves it through the wake ladder instead).
+TIERING = LifecycleMachine(
+    stream="tiering",
+    journal_name="hibernation.journal",
+    states=(INITIAL, "hibernating", "hibernated", "resident",
+            "reclaimed"),
+    transitions=(
+        Transition(HIBERNATE, (INITIAL, "resident"), "hibernating",
+                   meta=("ticket", "seq", "steps", "nbytes", "model")),
+        Transition(HIBERNATED, ("hibernating",), "hibernated",
+                   meta=("ticket", "seq", "disk_bytes")),
+        Transition(WAKE, ("hibernated",), "resident",
+                   meta=("ticket", "seq", "source")),
+        Transition(REQUEUE, ("resident",), "hibernated",
+                   meta=("ticket", "seq")),
+        Transition(RECLAIM, (INITIAL, "hibernating", "hibernated",
+                             "resident"), "reclaimed", terminal=True,
+                   meta=("ticket",)),
+    ),
+)
+
+#: both declared machines, keyed by stream name
+MACHINES = {FLEET.stream: FLEET, TIERING.stream: TIERING}
+
+
+def machine_for_journal(path: str) -> Optional[LifecycleMachine]:
+    """The machine owning a journal file, by basename — how the runtime
+    witness classifies a live ``TicketJournal`` append stream. None for
+    a journal the protocol does not declare (a user's ad-hoc journal
+    must not trip the witness)."""
+    import os
+
+    base = os.path.basename(path)
+    for m in MACHINES.values():
+        if m.journal_name == base:
+            return m
+    return None

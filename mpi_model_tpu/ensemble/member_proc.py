@@ -266,6 +266,20 @@ class MemberServer:
         finally:
             self.conn.close()
 
+    #: reply-send bound: a supervisor that stopped draining its socket
+    #: must fence THIS member (WireTimeout ends the serve loop, the
+    #: conn closes, the peer sees EOF), never wedge the loop forever
+    #: on a full socket buffer
+    REPLY_DEADLINE_S = 60.0
+
+    def _reply(self, kind: str, meta: Optional[dict] = None,
+               arrays: Optional[dict] = None) -> None:
+        """Every server reply crosses here so each send carries the
+        bounded deadline — the rpc-no-deadline protocol rule keeps raw
+        sends from creeping back in."""
+        self.conn.send(kind, meta, arrays,
+                       deadline_s=self.REPLY_DEADLINE_S)
+
     def _handle(self, kind: str, meta: dict, arrays) -> bool:
         try:
             if kind == "submit":
@@ -275,7 +289,7 @@ class MemberServer:
             if kind == "migrate":
                 return self._handle_migrate(meta)
             if kind == "queued":
-                self.conn.send("ok", {
+                self._reply("ok", {
                     "tickets": self.service.scheduler.queued_tickets()})
                 return False
             if kind == "pump":
@@ -289,18 +303,14 @@ class MemberServer:
                     # is not — same split as _handle_pump
                     with self._lock:
                         self._pump_dead = True
-                self.conn.send("ok", {})
-                return False
-            if kind == "stats":
-                self.conn.send("ok", {
-                    "stats": _jsonable(self.service.stats())})
+                self._reply("ok", {})
                 return False
             if kind == "dispatch_log":
-                self.conn.send("ok", {"entries": _jsonable(
+                self._reply("ok", {"entries": _jsonable(
                     list(self.service.scheduler.dispatch_log))})
                 return False
             if kind == "heartbeat":
-                self.conn.send("ok", {"telemetry": self._telemetry()})
+                self._reply("ok", {"telemetry": self._telemetry()})
                 return False
             if kind == "shutdown":
                 if meta.get("mode") == "abandon":
@@ -309,9 +319,9 @@ class MemberServer:
                     self.service.stop()
                 with self._lock:
                     self.clean_shutdown = True
-                self.conn.send("ok", {})
+                self._reply("ok", {})
                 return True
-            self.conn.send("err", {"error": "ValueError",
+            self._reply("err", {"error": "ValueError",
                                    "detail": f"unknown RPC {kind!r}"})
             return False
         # analysis: ignore[broad-except] — the RPC supervisor: ANY
@@ -320,7 +330,7 @@ class MemberServer:
         # reply CONNECTION re-raises out of the send itself, which is
         # the one failure that legitimately ends serving)
         except Exception as e:
-            self.conn.send("err", self._err_meta(e))
+            self._reply("err", self._err_meta(e))
             return False
 
     @staticmethod
@@ -346,25 +356,25 @@ class MemberServer:
             if meta.get("migrated"):
                 with sched._lock:
                     sched.migrated_in += 1
-            self.conn.send("ok", {"ticket": ticket})
+            self._reply("ok", {"ticket": ticket})
             return False
         try:
             with get_tracer().attach(ctx):
                 ticket = self.service.submit(space, model=model,
                                              steps=steps)
         except ServiceOverloaded as e:
-            self.conn.send("overloaded", {
+            self._reply("overloaded", {
                 "detail": str(e), "queue_depth": e.queue_depth,
                 "retry_after_s": e.retry_after_s})
             return False
-        self.conn.send("ok", {"ticket": ticket})
+        self._reply("ok", {"ticket": ticket})
         return False
 
     def _handle_poll(self, meta: dict) -> bool:
         try:
             res = self.service.poll(meta["ticket"])
         except KeyError as e:
-            self.conn.send("err", {"error": "KeyError", "detail": str(e)})
+            self._reply("err", {"error": "KeyError", "detail": str(e)})
             return False
         # analysis: ignore[broad-except] — the harvest seam crosses the
         # wire here: every per-ticket resolution error (quarantine,
@@ -377,15 +387,15 @@ class MemberServer:
             t = getattr(e, "ticket", None)
             if t is not None:
                 body["ticket"] = t
-            self.conn.send("err", body)
+            self._reply("err", body)
             return False
         if res is None:
-            self.conn.send("pending", {})
+            self._reply("pending", {})
             return False
         space, report = res
         s_meta, s_arrays = space_payload(space)
         s_meta["report"] = _report_meta(report)
-        self.conn.send("ok", s_meta, s_arrays)
+        self._reply("ok", s_meta, s_arrays)
         return False
 
     def _handle_migrate(self, meta: dict) -> bool:
@@ -393,14 +403,14 @@ class MemberServer:
         try:
             space, model, steps = sched.extract_ticket(meta["ticket"])
         except (TicketNotMigratable, KeyError) as e:
-            self.conn.send("err", self._err_meta(e))
+            self._reply("err", self._err_meta(e))
             return False
         recipe = model_meta(model)
         if recipe is None:  # pragma: no cover - defensive: every model
             # on a wire member arrived AS a recipe; put it back rather
             # than lose a scenario we cannot serialize
             sched.submit(space, model, steps)
-            self.conn.send("err", {
+            self._reply("err", {
                 "error": "TicketNotMigratable",
                 "detail": "scenario model has no wire recipe"})
             return False
@@ -410,17 +420,17 @@ class MemberServer:
                 "steps": steps})
         s_meta, s_arrays = space_payload(space)
         s_meta.update({"steps": steps, "model": recipe})
-        self.conn.send("ok", s_meta, s_arrays)
+        self._reply("ok", s_meta, s_arrays)
         return False
 
     def _handle_pump(self, meta: dict) -> bool:
         if self.pump == "thread":
-            self.conn.send("ok", {"did": False})
+            self._reply("ok", {"did": False})
             return False
         with self._lock:
             dead = self._pump_dead
         if dead:
-            self.conn.send("ok", {"did": False, "killed": True})
+            self._reply("ok", {"did": False, "killed": True})
             return False
         try:
             did = self.service.pump_once(force=bool(meta.get("force")))
@@ -430,16 +440,16 @@ class MemberServer:
             # client's re-raise, PR 10 semantics exactly
             with self._lock:
                 self._pump_dead = True
-            self.conn.send("ok", {"did": True, "killed": True})
+            self._reply("ok", {"did": True, "killed": True})
             return False
         # analysis: ignore[broad-except] — the manual-mode pump
         # supervisor (mirrors AsyncEnsembleService._loop across the
         # wire): a pump fault is counted member-side and survived
         except Exception:
             self.service.scheduler.counter.bump("loop_faults")
-            self.conn.send("ok", {"did": True})
+            self._reply("ok", {"did": True})
             return False
-        self.conn.send("ok", {"did": bool(did)})
+        self._reply("ok", {"did": bool(did)})
         return False
 
     def _telemetry(self) -> dict:
@@ -587,6 +597,10 @@ class _RemoteScheduler:
         if kind == "err":
             _raise_remote(meta)
         return target.submit_payload(
+            # analysis: ignore[rpc-asymmetry] — the migrate reply meta
+            # IS a space payload: dim_x/dim_y are stamped by the
+            # payload codec (journal.space_payload), a vocabulary the
+            # server-side literal scan cannot see
             {"dim_x": meta["dim_x"], "dim_y": meta["dim_y"],
              "steps": meta["steps"], "model": meta["model"],
              "migrated": True},

@@ -74,12 +74,15 @@ from ..resilience import inject, lockdep
 from ..utils.metrics import ThroughputCounter
 from ..utils.tracing import get_tracer
 from .journal import TicketJournal, model_from_meta, model_meta, read_records
+from .lifecycle import HIBERNATE, HIBERNATED, RECLAIM, REQUEUE, TIERING, WAKE
 
 __all__ = ["HibernationError", "HibernatedScenario", "ScenarioTiering",
            "scenario_nbytes"]
 
-#: the TJ1 lifecycle journal inside a vault directory
-HIBERNATE_JOURNAL = "hibernation.journal"
+#: the TJ1 lifecycle journal inside a vault directory — the basename is
+#: the DECLARED machine's (``lifecycle.TIERING``): it is how the
+#: protocol witness maps this stream back to its lifecycle
+HIBERNATE_JOURNAL = TIERING.journal_name
 #: chain file prefix inside each per-ticket chain directory
 CHAIN_PREFIX = "hib"
 
@@ -246,7 +249,7 @@ class ScenarioTiering:
         d = self._chain_dir(ticket)
         if chain is None and not os.path.isdir(d):
             return
-        self._append_locked("reclaim", {"ticket": ticket})
+        self._append_locked(RECLAIM, {"ticket": ticket})
         if os.path.isdir(d):
             shutil.rmtree(d, ignore_errors=True)
 
@@ -297,7 +300,7 @@ class ScenarioTiering:
                 raise ValueError(f"ticket {ticket} is already hibernated")
             seq = self._next_seq.get(ticket, 0)
             rehib = seq > 0
-            self._append_locked("hibernate", {
+            self._append_locked(HIBERNATE, {
                 "ticket": int(ticket), "seq": seq, "steps": int(steps),
                 "nbytes": nbytes, "model": model_meta(model)})
             chain = self._chain_for_locked(ticket)
@@ -310,7 +313,7 @@ class ScenarioTiering:
             inject.hibernate_torn(path, seq)
             self._next_seq[ticket] = seq + 1
             disk = self._dir_bytes(ticket)
-            self._append_locked("hibernated", {
+            self._append_locked(HIBERNATED, {
                 "ticket": int(ticket), "seq": seq, "disk_bytes": disk})
             now = self._clock()
             entry = HibernatedScenario(
@@ -420,7 +423,7 @@ class ScenarioTiering:
                     "fresh or wrong state")
             if source == "journal":
                 self.counter.bump("wake_faults")
-            self._append_locked("wake", {
+            self._append_locked(WAKE, {
                 "ticket": int(ticket), "seq": e.seq, "source": source})
             self._hibernated.pop(ticket)
             self._hibernated_bytes -= e.disk_bytes
@@ -438,7 +441,7 @@ class ScenarioTiering:
         journal records the round trip so recovery still sees it
         hibernated."""
         with self._lock:
-            self._append_locked("requeue", {
+            self._append_locked(REQUEUE, {
                 "ticket": int(ticket), "seq": entry.seq})
             self._hibernated[ticket] = entry
             self._hibernated.move_to_end(ticket, last=False)
@@ -486,30 +489,34 @@ class ScenarioTiering:
                 f"hibernation journal {self.journal.path} had a torn "
                 "tail — recovered the verified prefix",
                 RuntimeWarning)
+        # the fold consumes the DECLARED machine (lifecycle.TIERING)
+        # instead of hand-rolled kind literals: each record advances its
+        # ticket to the transition's declared target state, and a ticket
+        # is recoverable here iff it ended the prefix on the hibernate
+        # side of the machine (intent or commit — not resident).
         state: dict = {}
         for rec in records:
             t = rec.meta.get("ticket")
-            if t is None:
+            tr = TIERING.transition(rec.kind)
+            if t is None or tr is None:
                 continue
-            if rec.kind == "hibernate":
-                state[t] = {"meta": rec.meta, "seq": rec.meta["seq"],
-                            "committed": False, "hibernated": True,
-                            "order": rec.index}
-            elif rec.kind == "hibernated" and t in state:
-                state[t]["committed"] = True
-                state[t]["disk"] = rec.meta.get("disk_bytes", 0)
-            elif rec.kind == "requeue" and t in state:
-                state[t]["hibernated"] = True
-            elif rec.kind == "wake" and t in state:
-                state[t]["hibernated"] = False
-            elif rec.kind == "reclaim":
+            if tr.terminal:
                 state.pop(t, None)
+            elif rec.kind == HIBERNATE:
+                state[t] = {"meta": rec.meta, "seq": rec.meta["seq"],
+                            "committed": False, "state": tr.target,
+                            "order": rec.index}
+            elif t in state:
+                if rec.kind == HIBERNATED:
+                    state[t]["committed"] = True
+                    state[t]["disk"] = rec.meta.get("disk_bytes", 0)
+                state[t]["state"] = tr.target
         out: dict = {}
         now = self._clock()
         with self._lock:
             for t, st in sorted(state.items(),
                                 key=lambda kv: kv[1]["order"]):
-                if not st["hibernated"]:
+                if st["state"] not in ("hibernating", "hibernated"):
                     continue
                 meta = st["meta"]
                 model = model_from_meta(meta.get("model"), template_model)
@@ -546,7 +553,7 @@ class ScenarioTiering:
                 t = int(fn[1:])
                 if t in self._hibernated:
                     continue
-                self._append_locked("reclaim", {"ticket": t})
+                self._append_locked(RECLAIM, {"ticket": t})
                 shutil.rmtree(os.path.join(self.directory, fn),
                               ignore_errors=True)
                 self._next_seq.pop(t, None)

@@ -81,9 +81,12 @@ _HEADER_LEN = 22  # b"TW1 " + 8 hex + b" " + 8 hex + b"\n"
 MAX_FRAME_BYTES = 1 << 30
 
 #: the member RPC vocabulary (supervisor → member); every request gets
-#: exactly one reply frame
+#: exactly one reply frame. (A ``stats`` RPC existed once but nothing
+#: ever sent it — member stats ride the heartbeat telemetry cut so the
+#: fleet's ``stats()`` never blocks on a wire; the layer-4
+#: ``rpc-asymmetry`` rule is what keeps this tuple honest now.)
 REQUEST_KINDS = ("submit", "poll", "migrate", "queued", "pump", "drain",
-                 "stats", "dispatch_log", "heartbeat", "shutdown")
+                 "dispatch_log", "heartbeat", "shutdown")
 #: reply kinds (member → supervisor)
 REPLY_KINDS = ("ok", "pending", "overloaded", "err")
 

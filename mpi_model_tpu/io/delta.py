@@ -205,10 +205,15 @@ class _RecordReader:
                 f"{type(e).__name__}: {e}") from e
         try:
             self.meta = json.loads(bytes(self._z["meta"]).decode("utf-8"))
-            if self.meta.get("format") != DELTA_FORMAT_VERSION:
+            # analysis: ignore[journal-meta-drift] — this is the delta
+            # CHAIN record's meta (the checkpoint codec's vocabulary),
+            # not a ticket-journal record; the lifecycle machines do
+            # not govern it
+            fmt = self.meta.get("format")
+            if fmt != DELTA_FORMAT_VERSION:
                 raise CheckpointCorruptionError(
                     f"chain record {path} has unsupported format "
-                    f"{self.meta.get('format')!r}")
+                    f"{fmt!r}")
         except CheckpointCorruptionError:
             self._z.close()  # a raising __init__ must not leak the zip
             raise

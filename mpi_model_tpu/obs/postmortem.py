@@ -37,9 +37,20 @@ from typing import Optional
 
 __all__ = ["Timeline", "TimelineEvent", "reconstruct", "spans_from_chrome"]
 
-#: journal kinds that terminate a ticket (mirrors journal.TERMINAL_KINDS
-#: without importing it at module load — obs must stay import-light)
-_TERMINAL = ("served", "quarantined", "expired")
+def _fleet_machine():
+    """The declared fleet lifecycle machine (ISSUE 19) — imported
+    lazily and cached so obs stays import-light: ``ensemble.lifecycle``
+    is stdlib-only, but naming it at module load would execute
+    ``ensemble/__init__`` and pull the jax-laden serving stack."""
+    global _FLEET
+    if _FLEET is None:
+        from ..ensemble.lifecycle import FLEET
+
+        _FLEET = FLEET
+    return _FLEET
+
+
+_FLEET = None
 
 
 @dataclasses.dataclass
@@ -150,6 +161,9 @@ def _read_records_cached(path: str):
 def _journal_events(ticket: int, path: str, source: str) -> tuple:
     """(events, submit_meta, terminal_kinds) for ``ticket`` from one
     TJ1 journal file."""
+    from ..ensemble.lifecycle import SUBMIT
+
+    machine = _fleet_machine()
     events: list = []
     submit_meta: Optional[dict] = None
     terminals: list = []
@@ -169,9 +183,9 @@ def _journal_events(ticket: int, path: str, source: str) -> tuple:
         events.append(TimelineEvent(
             t_wall=rec.meta.get("t_wall"), source=source, kind=rec.kind,
             detail="; ".join(bits), service_id=sid, order=rec.index))
-        if rec.kind == "submit" and submit_meta is None:
+        if rec.kind == SUBMIT and submit_meta is None:
             submit_meta = rec.meta
-        if rec.kind in _TERMINAL:
+        if machine.is_terminal(rec.kind):
             terminals.append(rec.kind)
     if torn:
         events.append(TimelineEvent(
@@ -243,8 +257,8 @@ def reconstruct(ticket: int, *, journal_dir: Optional[str] = None,
     if submit_meta is not None and not terminals:
         last_sid = submit_meta.get("service_id")
         for e in events:
-            if e.source == "journal" and e.kind in ("readmit", "migrate",
-                                                    "wake"):
+            if (e.source == "journal"
+                    and e.kind in _fleet_machine().attribution_kinds()):
                 last_sid = e.service_id or last_sid
                 # readmit/migrate/wake meta carries to= in the detail;
                 # the service_id field is what we surface

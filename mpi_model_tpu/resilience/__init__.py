@@ -25,14 +25,15 @@ _SUPERVISOR_SYMBOLS = (
     "supervised_run",
 )
 
-__all__ = list(_SUPERVISOR_SYMBOLS) + ["inject", "lockdep", "supervisor"]
+__all__ = list(_SUPERVISOR_SYMBOLS) + [
+    "inject", "lockdep", "protocolcheck", "supervisor"]
 
 
 def __getattr__(name: str):
     if name in _SUPERVISOR_SYMBOLS:
         return getattr(importlib.import_module(".supervisor", __name__),
                        name)
-    if name in ("inject", "lockdep", "supervisor"):
+    if name in ("inject", "lockdep", "protocolcheck", "supervisor"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 

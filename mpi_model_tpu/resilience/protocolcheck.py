@@ -1,0 +1,199 @@
+"""Runtime protocol witness (ISSUE 19): validates LIVE journal streams
+against the declared ticket-lifecycle machines
+(``ensemble.lifecycle``), the way ``lockdep`` validates live lock
+orders against the static acquisition graph.
+
+The static protocol layer (``analysis.protocol``) proves the writer
+and reader vocabularies agree with the declaration; this module
+witnesses the transitions that actually happen — under the chaos
+matrix — and catches what static analysis structurally cannot: the
+ORDER of records on a live stream. An append site can be perfectly
+declared and still emit a terminal twice, wake a ticket whose
+hibernation never committed, or replay a transition out of a state the
+machine forbids.
+
+Same one-global-read-when-disarmed discipline as ``inject`` and
+``lockdep``: ``TicketJournal.append`` calls :func:`journal_append`
+after every durable write; while no witness is armed that is a single
+module-global read and an immediate return — zero bookkeeping, no
+imports, and step jaxprs are untouched (journals are host-side only;
+pinned by ``tests/test_protocolcheck.py``).
+
+What the witness records per observed append, keyed by
+``(stream, ticket)`` — the stream resolved from the journal file's
+basename (``lifecycle.machine_for_journal``):
+
+- **undeclared-kind** — a record kind the stream's machine has no
+  transition for (a writer drifted past the declaration);
+- **missing-ticket** — a per-ticket kind appended without a ticket id
+  (the fold and the timeline would both lose the record);
+- **duplicate-terminal** — a terminal for a ticket already resolved:
+  the exactly-once invariant broken at write time, caught before any
+  replay audit runs;
+- **wake-without-commit** — a tiering ``wake`` for a ticket whose
+  ``hibernate`` intent was witnessed but whose ``hibernated`` commit
+  never was (legal only through crash recovery's wake ladder, never on
+  a live stream);
+- **illegal-transition** — any other declared kind arriving from a
+  state its transition does not list as a source.
+
+A ticket FIRST seen mid-lifecycle (the witness armed around a
+recovery, a journal reopened mid-test) is ADOPTED at the record's
+target state instead of flagged: the witness asserts the legality of
+what it saw, never guesses about history it did not.
+
+Violations are recorded, not raised mid-serve — a witnessed fleet must
+keep serving; chaos rows call ``assert_clean()`` afterwards, exactly
+like the lockdep rows.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+__all__ = [
+    "ProtocolViolation",
+    "ProtocolWitness",
+    "active",
+    "armed",
+    "journal_append",
+]
+
+
+class ProtocolViolation(AssertionError):
+    """Raised by ``ProtocolWitness.assert_clean`` — carries the
+    recorded violations so a failing chaos row prints the actual
+    stream."""
+
+    def __init__(self, violations: list):
+        super().__init__(
+            "protocolcheck witnessed %d violation(s):\n%s" % (
+                len(violations),
+                "\n".join(
+                    f"  [{v['kind']}] {v['stream']} ticket="
+                    f"{v['ticket']} record={v['record']!r} "
+                    f"state={v['state']!r}" for v in violations)))
+        self.violations = violations
+
+
+def _default_machines() -> dict:
+    # lazy: the declared machines load only when a witness arms (the
+    # disarmed hot path must not import anything)
+    from ..ensemble.lifecycle import MACHINES
+
+    return dict(MACHINES)
+
+
+class ProtocolWitness:
+    """Runtime state of one armed witness: per-(stream, ticket) state,
+    the observed-record count, and the violation log."""
+
+    def __init__(self, machines: Optional[dict] = None):
+        #: stream name → LifecycleMachine (default: the declared pair)
+        self.machines = (_default_machines() if machines is None
+                         else dict(machines))
+        self._by_name = {m.journal_name: m
+                         for m in self.machines.values()}
+        self._mu = threading.Lock()  # leaf lock guarding the records
+        self._state: dict = {}
+        #: observed (classified) appends — rows assert this is nonzero
+        #: so "zero violations" can never mean "witnessed nothing"
+        self.records = 0
+        #: [{"kind", "stream", "ticket", "record", "state"}]
+        self.violations: list = []
+        self._flagged: set = set()
+
+    def observe(self, path: str, kind: str, meta: dict) -> None:
+        """Classify one live append against its stream's machine."""
+        import os
+
+        machine = self._by_name.get(os.path.basename(path))
+        if machine is None:
+            return  # not a declared stream: never the witness's business
+        with self._mu:
+            self.records += 1
+            t = machine.transition(kind)
+            ticket = (meta or {}).get("ticket")
+            if t is None:
+                self._violation("undeclared-kind", machine.stream,
+                                ticket, kind, None)
+                return
+            if t.ticketless:
+                return
+            if ticket is None:
+                self._violation("missing-ticket", machine.stream,
+                                None, kind, None)
+                return
+            key = (machine.stream, ticket)
+            cur = self._state.get(key)
+            if cur is None and "new" not in t.sources:
+                # first sighting mid-lifecycle: adopt, never guess
+                self._state[key] = t.target
+                return
+            state = cur if cur is not None else "new"
+            if not machine.legal(kind, state):
+                if t.terminal and state == t.target:
+                    label = "duplicate-terminal"
+                elif (machine.stream == "tiering" and kind == "wake"
+                        and state == "hibernating"):
+                    label = "wake-without-commit"
+                else:
+                    label = "illegal-transition"
+                self._violation(label, machine.stream, ticket, kind,
+                                state)
+            # track the target either way: one bad record must not
+            # cascade into a violation per subsequent record
+            self._state[key] = t.target
+
+    def _violation(self, label: str, stream: str, ticket, record,
+                   state) -> None:
+        sig = (label, stream, ticket, record, state)
+        if sig in self._flagged:
+            return
+        self._flagged.add(sig)
+        self.violations.append({
+            "kind": label, "stream": stream, "ticket": ticket,
+            "record": record, "state": state})
+
+    # -- assertions ----------------------------------------------------------
+
+    def assert_clean(self) -> None:
+        if self.violations:
+            raise ProtocolViolation(list(self.violations))
+
+
+_ACTIVE: Optional[ProtocolWitness] = None
+
+
+def active() -> Optional[ProtocolWitness]:
+    """The armed witness, or None — THE fast path the journal seam
+    checks (one global read when protocolcheck is off)."""
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def armed(machines: Optional[dict] = None):
+    """Arm a witness for the duration of the block (one at a time —
+    overlapping witnesses would split the per-ticket state). Composes
+    with ``lockdep.armed`` and ``inject.armed`` — each has its own
+    global, so the chaos rows nest all three."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("a protocol witness is already armed")
+    w = ProtocolWitness(machines)
+    _ACTIVE = w
+    try:
+        yield w
+    finally:
+        _ACTIVE = None
+
+
+def journal_append(path: str, kind: str, meta: dict) -> None:
+    """The seam ``TicketJournal.append`` fires after every durable
+    write. One global read when disarmed."""
+    st = _ACTIVE
+    if st is None:
+        return
+    st.observe(path, kind, meta)

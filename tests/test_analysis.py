@@ -14,8 +14,10 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from mpi_model_tpu.analysis import (RULES, Severity, lint_source, main,
-                                    run_astlint)
+from mpi_model_tpu.analysis import (RULES, Severity,
+                                    lint_protocol_source, lint_source,
+                                    main, run_astlint,
+                                    run_protocol_audit)
 from mpi_model_tpu.analysis.concurrency import (lint_concurrency_source,
                                                 run_concurrency_audit,
                                                 static_lock_graph)
@@ -618,14 +620,16 @@ def test_naked_save_pragma_suppresses_with_reason():
 
 
 def test_repo_is_clean_under_strict_analysis():
-    """THE gate (ISSUE 4 acceptance; ISSUE 12 adds layer 3): zero
-    unsuppressed findings of any severity over the whole tree — AST
-    lint, concurrency audit AND jaxpr contracts — with every
-    suppression carrying a reason. This is the in-process equivalent of
-    ``python -m mpi_model_tpu.analysis --strict``."""
+    """THE gate (ISSUE 4 acceptance; ISSUE 12 adds layer 3, ISSUE 19
+    layer 4): zero unsuppressed findings of any severity over the whole
+    tree — AST lint, concurrency audit, protocol audit AND jaxpr
+    contracts — with every suppression carrying a reason. This is the
+    in-process equivalent of ``python -m mpi_model_tpu.analysis
+    --strict``."""
     roots = [REPO / p for p in DEFAULT_ROOTS if (REPO / p).exists()]
     findings = run_astlint(roots, rel_to=REPO)
     findings.extend(run_concurrency_audit(roots, rel_to=REPO))
+    findings.extend(run_protocol_audit(rel_to=REPO))
     findings.extend(run_jaxpr_audit())
     blocking = [f for f in findings if not f.suppressed]
     assert blocking == [], "\n" + "\n".join(f.format() for f in blocking)
@@ -1144,3 +1148,256 @@ def test_cli_rule_filter_accepts_concurrency_rule_ids(capsys):
     assert main(["--rule", "lock-order", "--json"]) == 0
     payload = json.loads(capsys.readouterr().out)
     assert payload["blocking"] == []
+
+
+# -- protocol audit (ISSUE 19 layer 4): journal/wire conformance --------------
+
+def proto_rules_of(src, rules=None):
+    return [f.rule for f in lint_protocol_source(src, PKG, rules)
+            if not f.suppressed]
+
+
+def test_journal_kind_drift_positive():
+    # an append writing a typo'd kind and a fold dispatching on a kind
+    # no machine declares are both vocabulary forks
+    src = ("def fold(records, journal):\n"
+           "    journal.append(\"sevred\", {\"ticket\": \"t\"}, None)\n"
+           "    for rec in records:\n"
+           "        if rec.kind == \"finished\":\n"
+           "            pass\n")
+    assert proto_rules_of(src) == ["journal-kind-drift"] * 2
+
+
+def test_journal_kind_drift_negative():
+    # declared kinds — via the lifecycle constant on the writer side
+    # and the (reader-legal) literal on the dispatch side — are clean,
+    # and an unresolvable kind contributes nothing rather than guessing
+    src = ("from mpi_model_tpu.ensemble.lifecycle import SERVED\n"
+           "def fold(records, journal, k):\n"
+           "    journal.append(SERVED, None, None)\n"
+           "    journal.append(k, None, None)\n"
+           "    for rec in records:\n"
+           "        if rec.kind == \"served\":\n"
+           "            pass\n")
+    assert proto_rules_of(src) == []
+
+
+def test_journal_meta_drift_both_directions():
+    # reader pulls a key nothing stamps; writer stamps a key the kind's
+    # transition does not declare — both directions of the same drift
+    src = ("def fold(rec, journal):\n"
+           "    journal.append(\"served\", {\"bogus\": 1}, None)\n"
+           "    return rec.meta.get(\"ghost_key\")\n")
+    assert proto_rules_of(src) == ["journal-meta-drift"] * 2
+    out = lint_protocol_source(src, PKG)
+    assert all(f.severity is Severity.WARNING for f in out)
+
+
+def test_journal_meta_drift_negative_declared_keys():
+    src = ("def fold(rec, journal):\n"
+           "    journal.append(\"served\", {\"ticket\": \"t\"}, None)\n"
+           "    rec.meta[\"t_wall\"]\n"
+           "    return rec.meta.get(\"ticket\")\n")
+    assert proto_rules_of(src) == []
+
+
+def test_journal_meta_drift_pragma_escape():
+    src = ("def fold(rec):\n"
+           "    # analysis: ignore[journal-meta-drift] — probing a\n"
+           "    # legacy key from pre-machine journals\n"
+           "    return rec.meta.get(\"legacy_key\")\n")
+    assert proto_rules_of(src) == []
+
+
+def test_rpc_asymmetry_positive():
+    # one module, both halves: a dead server handler, an undeclared
+    # reply kind, and a client reply-field read nothing stamps
+    src = ("class MemberServer:\n"
+           "    def _handle(self, kind, meta):\n"
+           "        if kind == \"submit\":\n"
+           "            self.conn.send(\"ok\", {\"ticket\": \"t\"},\n"
+           "                           None, deadline_s=5.0)\n"
+           "        elif kind == \"stats\":\n"
+           "            self.conn.send(\"gladly\", None, None,\n"
+           "                           deadline_s=5.0)\n"
+           "class Client:\n"
+           "    def submit(self):\n"
+           "        kind, meta, arrays = self._rpc(\"submit\")\n"
+           "        return meta[\"ticket\"], meta[\"ghost\"]\n")
+    assert proto_rules_of(src) == ["rpc-asymmetry"] * 3
+
+
+def test_rpc_asymmetry_negative_symmetric_protocol():
+    src = ("class MemberServer:\n"
+           "    def _handle(self, kind, meta):\n"
+           "        if kind == \"submit\":\n"
+           "            self.conn.send(\"ok\", {\"ticket\": \"t\"},\n"
+           "                           None, deadline_s=5.0)\n"
+           "class Client:\n"
+           "    def submit(self):\n"
+           "        kind, meta, arrays = self._rpc(\"submit\")\n"
+           "        return meta[\"ticket\"]\n")
+    assert proto_rules_of(src) == []
+
+
+def test_rpc_asymmetry_quiet_without_both_halves():
+    # a server-only module cannot prove a handler dead (the client may
+    # live elsewhere) — the pairing directions need both halves in view
+    src = ("class MemberServer:\n"
+           "    def _handle(self, kind, meta):\n"
+           "        if kind == \"submit\":\n"
+           "            self.conn.send(\"ok\", None, None,\n"
+           "                           deadline_s=5.0)\n")
+    assert proto_rules_of(src) == []
+
+
+def test_rpc_no_deadline_positive():
+    src = ("def push(conn):\n"
+           "    conn.send(\"submit\", None, None)\n"
+           "    return conn.recv()\n")
+    assert proto_rules_of(src) == ["rpc-no-deadline"] * 2
+
+
+def test_rpc_no_deadline_explicit_decision_passes():
+    # deadline_s=None is a RECORDED decision to wait forever; silence
+    # is the finding, not the unbounded wait itself
+    src = ("def push(conn, payload):\n"
+           "    conn.send(\"submit\", None, None, deadline_s=None)\n"
+           "    return conn.recv(deadline_s=30.0)\n")
+    assert proto_rules_of(src) == []
+    # non-wire receivers (list.append-style sends) never alias in
+    src2 = ("def f(bus):\n"
+            "    bus.send(\"submit\")\n"
+            "    bus.recv()\n")
+    assert proto_rules_of(src2) == []
+
+
+def test_terminal_coverage_positive():
+    # a journaling class dropping a ticket from a ledger with no
+    # journal evidence: replay will resurrect what the process dropped
+    src = ("class Fleet:\n"
+           "    def _note(self, kind):\n"
+           "        self._journal_append_locked(kind, {}, None)\n"
+           "    def drop(self, ticket):\n"
+           "        self._route.pop(ticket, None)\n")
+    assert proto_rules_of(src) == ["terminal-coverage"]
+
+
+def test_terminal_coverage_escapes():
+    # journal evidence in the same method, a sanctioned resolution
+    # helper, or a poll-style handoff all sanction the removal
+    evidence = ("from mpi_model_tpu.ensemble.lifecycle import EXPIRED\n"
+                "class Fleet:\n"
+                "    def drop(self, ticket):\n"
+                "        self._route.pop(ticket, None)\n"
+                "        self._journal_append_locked(EXPIRED,\n"
+                "                                    {\"ticket\": ticket},\n"
+                "                                    None)\n")
+    assert proto_rules_of(evidence) == []
+    helper = ("class Fleet:\n"
+              "    def _note(self, k):\n"
+              "        self._journal_append_locked(k, {}, None)\n"
+              "    def drop(self, ticket):\n"
+              "        self._route.pop(ticket, None)\n"
+              "        self._reclaim_locked(ticket)\n")
+    assert proto_rules_of(helper) == []
+    handoff = ("class Fleet:\n"
+               "    def _note(self, k):\n"
+               "        self._journal_append_locked(k, {}, None)\n"
+               "    def poll(self, ticket):\n"
+               "        return self._results.pop(ticket, None)\n")
+    assert proto_rules_of(handoff) == []
+
+
+def test_terminal_coverage_only_in_journaling_classes():
+    # a class that never journals has no replay contract to break
+    src = ("class Cache:\n"
+           "    def drop(self, ticket):\n"
+           "        self._route.pop(ticket, None)\n")
+    assert proto_rules_of(src) == []
+
+
+def test_event_kind_coverage():
+    src = ("def boom():\n"
+           "    return FailureEvent(kind=\"meteor\", member=0)\n")
+    assert proto_rules_of(src) == ["event-kind-coverage"]
+    ok = ("def boom(k):\n"
+          "    FailureEvent(kind=\"exception\", member=0)\n"
+          "    FailureEvent(kind=k, member=0)\n")  # unresolvable: quiet
+    assert proto_rules_of(ok) == []
+
+
+def test_protocol_rule_filter():
+    # the rules= selection narrows the emitted set (the CLI --rule path)
+    src = ("def fold(rec, journal):\n"
+           "    journal.append(\"sevred\", None, None)\n"
+           "    return rec.meta.get(\"ghost_key\")\n")
+    assert proto_rules_of(src, rules=["journal-kind-drift"]) == [
+        "journal-kind-drift"]
+
+
+# -- journal-kind-literal (ISSUE 19 satellite: the astlint pincer) ------------
+
+def test_journal_kind_literal_positive():
+    src = ("def f(journal, rec):\n"
+           "    journal.append(\"served\", None, None)\n"
+           "    if rec.kind == \"submit\":\n"
+           "        pass\n")
+    assert rules_of(lint_source(src, PKG)) == ["journal-kind-literal"] * 2
+
+
+def test_journal_kind_literal_negative():
+    # lifecycle constants are the sanctioned spelling; non-vocabulary
+    # literals (fault-plan kinds etc.) are out of scope
+    src = ("from mpi_model_tpu.ensemble.lifecycle import SERVED\n"
+           "def f(journal, rec):\n"
+           "    journal.append(SERVED, None, None)\n"
+           "    if rec.kind == \"exc\":\n"
+           "        pass\n")
+    assert rules_of(lint_source(src, PKG)) == []
+
+
+def test_journal_kind_literal_lifecycle_module_exempt():
+    # the declaration module IS the single spelling site
+    src = ("def f(journal):\n"
+           "    journal.append(\"served\", None, None)\n")
+    assert rules_of(lint_source(
+        src, "mpi_model_tpu/ensemble/lifecycle.py")) == []
+    assert rules_of(lint_source(src, PKG)) == ["journal-kind-literal"]
+
+
+# -- CLI surface for the new layer (ISSUE 19 satellite 1) ---------------------
+
+def test_cli_rule_filter_accepts_protocol_rule_ids(capsys):
+    assert main(["--rule", "journal-kind-drift", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["blocking"] == []
+
+
+def test_cli_unknown_rule_suggests_close_match(capsys):
+    assert main(["--rule", "journal-kind-dirft"]) == 2
+    err = capsys.readouterr().err
+    assert "did you mean 'journal-kind-drift'" in err
+
+
+def test_cli_engine_only_rule_selection_errors(capsys):
+    # bare-pragma/parse-error are synthesized alongside real checks; a
+    # selection of only them would scan nothing and report a hollow pass
+    assert main(["--rule", "bare-pragma"]) == 2
+    assert "engine-synthesized" in capsys.readouterr().err
+
+
+def test_cli_json_findings_carry_rule_doc_and_fix_hint(capsys):
+    target = str(REPO / "mpi_model_tpu" / "io" / "delta.py")
+    assert main(["--rule", "journal-meta-drift", "--json", target]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    sup = payload["suppressed"]
+    assert sup, "the delta-codec pragma'd read should surface here"
+    assert all(f["rule_doc"] and f["fix_hint"] for f in sup)
+
+
+def test_every_rule_declares_a_fix_hint():
+    # jaxpr_audit is imported at module top, so all 4 layers + engine
+    # rules are registered by now
+    missing = [n for n, r in RULES.items() if not r.fix_hint]
+    assert missing == []

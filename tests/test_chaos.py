@@ -952,6 +952,11 @@ def test_check_health_single_device_get(monkeypatch):
 # inside `lockdep.armed(allowed=...)`, so all its locks are witnessed
 # and every actual acquisition order under chaos must (a) contain no
 # inversion and (b) already be an edge the concurrency auditor proved.
+#
+# ISSUE 19: the protocol witness arms alongside it (separate global, so
+# the two nest) — every journal record chaos provokes must be a legal
+# transition of the declared lifecycle machines, with no duplicate
+# terminals and no uncommitted wakes.
 
 def _fleet(**kw):
     from mpi_model_tpu.ensemble import FleetSupervisor
@@ -1008,7 +1013,7 @@ FLEET_MATRIX = {
 
 @pytest.mark.parametrize("kind", sorted(FLEET_MATRIX))
 def test_fleet_matrix_every_ticket_resolves(kind):
-    from mpi_model_tpu.resilience import lockdep
+    from mpi_model_tpu.resilience import lockdep, protocolcheck
 
     faults, extra, expect = FLEET_MATRIX[kind]
     extra = dict(extra)
@@ -1016,7 +1021,8 @@ def test_fleet_matrix_every_ticket_resolves(kind):
         clock = {"t": 0.0}
         extra["clock"] = lambda: clock["t"]
     served = failed = 0
-    with lockdep.armed(allowed=_allowed_graph()) as witness:
+    with lockdep.armed(allowed=_allowed_graph()) as witness, \
+            protocolcheck.armed() as pw:
         fleet = _fleet(**extra)  # built armed: every lock is witnessed
         with inject.armed(FaultPlan(faults)) as st, \
                 warnings.catch_warnings():
@@ -1036,6 +1042,10 @@ def test_fleet_matrix_every_ticket_resolves(kind):
     # observed order already proven by the static graph
     assert witness.edges, f"{kind}: the witness saw no acquisitions"
     witness.assert_clean()
+    # the protocol acceptance: whatever chaos did, every record was a
+    # legal transition (journal-less rows witness zero records — the
+    # tiered matrix covers the journaling runs)
+    pw.assert_clean()
     assert st.fired, f"{kind}: fault never fired"
     assert served + failed == 4          # zero silent drops
     stats = fleet.stats()
@@ -1060,10 +1070,11 @@ def test_fleet_matrix_member_kill_then_wedge():
     kind="member" events. Lockdep-armed (ISSUE 12): fencing/restart is
     the lock-heaviest supervision path, and it must stay inversion-free
     and inside the static graph."""
-    from mpi_model_tpu.resilience import lockdep
+    from mpi_model_tpu.resilience import lockdep, protocolcheck
 
     clock = {"t": 0.0}
-    with lockdep.armed(allowed=_allowed_graph()) as witness:
+    with lockdep.armed(allowed=_allowed_graph()) as witness, \
+            protocolcheck.armed() as pw:
         fleet = _fleet(supervision_deadline_s=1.0,
                        clock=lambda: clock["t"])
         with warnings.catch_warnings():
@@ -1094,6 +1105,7 @@ def test_fleet_matrix_member_kill_then_wedge():
         stats = fleet.stats()
         fleet.stop()
     witness.assert_clean()
+    pw.assert_clean()
     assert {f["kind"] for f in st1.fired} == {"member_kill"}
     assert "member_wedge" in {f["kind"] for f in st2.fired}
     assert len(outs) == 3 and len(outs2) == 3
@@ -1111,9 +1123,10 @@ def test_fleet_matrix_journal_torn_recovery(tmp_path):
     graph with zero inversions."""
     from mpi_model_tpu.ensemble import FleetSupervisor
     from mpi_model_tpu.ensemble.journal import journal_path, replay
-    from mpi_model_tpu.resilience import lockdep
+    from mpi_model_tpu.resilience import lockdep, protocolcheck
 
-    with lockdep.armed(allowed=_allowed_graph()) as witness:
+    with lockdep.armed(allowed=_allowed_graph()) as witness, \
+            protocolcheck.armed() as pw:
         fleet = _fleet(journal_dir=str(tmp_path), max_wait_s=1e9,
                        max_batch=8)
         t0 = fleet.submit(_scen_space(0))
@@ -1133,6 +1146,10 @@ def test_fleet_matrix_journal_torn_recovery(tmp_path):
         assert f2.result(t0) is not None  # the verified prefix recovers
         f2.stop()
     witness.assert_clean()
+    # the tear fires AFTER the witness observed the doomed append — the
+    # live process really did advance through every record it wrote
+    assert pw.records > 0
+    pw.assert_clean()
     state2 = replay(journal_path(str(tmp_path)))
     assert state2.unresolved() == [] and not state2.duplicate_terminals
 
@@ -1173,7 +1190,7 @@ TIERING_MATRIX.update({
 @pytest.mark.parametrize("kind", sorted(TIERING_MATRIX))
 def test_tiered_fleet_matrix_every_ticket_resolves(kind, tmp_path):
     from mpi_model_tpu.ensemble import scenario_nbytes
-    from mpi_model_tpu.resilience import lockdep
+    from mpi_model_tpu.resilience import lockdep, protocolcheck
 
     faults, extra, expect = TIERING_MATRIX[kind]
     extra = dict(extra)
@@ -1185,7 +1202,8 @@ def test_tiered_fleet_matrix_every_ticket_resolves(kind, tmp_path):
     # a budget that FITS), paging-tight for everything else
     budget = 16 * one if kind == "residency_pressure" else one + 1
     served = failed = 0
-    with lockdep.armed(allowed=_allowed_graph()) as witness:
+    with lockdep.armed(allowed=_allowed_graph()) as witness, \
+            protocolcheck.armed() as pw:
         fleet = _fleet(residency_budget=budget,
                        hibernate_dir=str(tmp_path / "vault"),
                        journal_dir=str(tmp_path / "journal"),
@@ -1206,6 +1224,10 @@ def test_tiered_fleet_matrix_every_ticket_resolves(kind, tmp_path):
                     failed += 1
     assert witness.edges, f"{kind}: the witness saw no acquisitions"
     witness.assert_clean()
+    # every tiered row journals: "clean" must mean "witnessed and
+    # legal", never "witnessed nothing"
+    assert pw.records > 0, f"{kind}: the protocol witness saw nothing"
+    pw.assert_clean()
     assert st.fired, f"{kind}: fault never fired"
     assert served + failed == 4          # zero silent drops
     stats = fleet.stats()
@@ -1239,12 +1261,13 @@ def test_tiering_kill_during_hibernate_recovers_exactly_once(tmp_path):
     silent fresh start, never a double resolution."""
     from mpi_model_tpu.ensemble import FleetSupervisor, scenario_nbytes
     from mpi_model_tpu.ensemble.journal import journal_path, replay
-    from mpi_model_tpu.resilience import lockdep
+    from mpi_model_tpu.resilience import lockdep, protocolcheck
 
     one = scenario_nbytes(_scen_space(0))
     jd, vd = str(tmp_path / "j"), str(tmp_path / "v")
     want = expected_final(make_model(4.0), _scen_space(2), steps=4)
-    with lockdep.armed(allowed=_allowed_graph()) as witness:
+    with lockdep.armed(allowed=_allowed_graph()) as witness, \
+            protocolcheck.armed() as pw:
         fleet = _fleet(residency_budget=2 * one + 1, journal_dir=jd,
                        hibernate_dir=vd, max_wait_s=1e9, max_batch=8)
         with warnings.catch_warnings():
@@ -1259,6 +1282,8 @@ def test_tiering_kill_during_hibernate_recovers_exactly_once(tmp_path):
             results = [f2.result(t) for t in tickets]
             f2.stop()
     witness.assert_clean()
+    assert pw.records > 0
+    pw.assert_clean()
     np.testing.assert_array_equal(
         np.asarray(results[2][0].values["value"]), want)
     state = replay(journal_path(jd))

@@ -465,3 +465,153 @@ def test_obs_cli_validate_prom_timeline(fresh_obs, tmp_path, capsys):
 
     # an unresolved ticket exits 1 (the scriptable post-mortem gate)
     assert main(["timeline", "12345", "--journal", jd]) == 1
+
+
+# -- ISSUE 19 golden pin: the lifecycle refactor changed no verdict ----------
+
+def _golden_journal(dirname, records, tear=None):
+    from mpi_model_tpu.ensemble.journal import TicketJournal, journal_path
+
+    dirname.mkdir(parents=True, exist_ok=True)
+    path = journal_path(str(dirname))
+    j = TicketJournal(path)
+    if tear is None:
+        for kind, meta in records:
+            j.append(kind, meta)
+    else:
+        plan = FaultPlan((Fault("journal_torn", at=tear, offset=3,
+                                tear="truncate"),))
+        with inject.armed(plan):
+            for kind, meta in records:
+                j.append(kind, meta)
+    j.close()
+    return path
+
+
+def _norm_tl(tl, path):
+    """to_dict with the tmpdir-dependent journal path canonicalised
+    (the ONLY run-dependent byte in any verdict)."""
+    return json.loads(json.dumps(tl.to_dict()).replace(path, "<journal>"))
+
+
+_GOLDEN_META = [
+    ("submit", {"ticket": 0, "service_id": "m0g0", "steps": 4,
+                "t_wall": 10.0}),
+    ("submit", {"ticket": 1, "service_id": "m1g0", "steps": 4,
+                "t_wall": 11.0}),
+    ("served", {"ticket": 0, "service_id": "m0g0", "steps": 4,
+                "t_wall": 12.0}),
+    ("served", {"ticket": 1, "service_id": "m1g0", "steps": 4,
+                "t_wall": 13.0}),
+]
+
+_TORN_NOTE = {
+    "detail": "some records carry no t_wall stamp (pre-ISSUE-15 "
+              "journal) — their order is record-index order, not "
+              "clock order",
+    "kind": "ordering-note", "order": float("-inf"),
+    "service_id": None, "source": "reconstruction", "t_wall": None}
+_TORN_TAIL = {
+    "detail": "<journal> had an unverifiable suffix — events "
+              "after the verified prefix are unknown",
+    "kind": "journal-torn-tail", "order": 2.5,
+    "service_id": None, "source": "journal", "t_wall": None}
+_NO_SUBMIT = {
+    "detail": "no verified submit record for this ticket — the "
+              "journal predates it, lost its tail, or the ticket id "
+              "is from another fleet",
+    "kind": "uncertainty", "order": float("-inf"),
+    "service_id": None, "source": "reconstruction", "t_wall": None}
+
+
+def _jev(kind, order, t_wall, sid, detail):
+    return {"detail": detail, "kind": kind, "order": order,
+            "service_id": sid, "source": "journal", "t_wall": t_wall}
+
+
+def test_golden_verdicts_exactly_once(tmp_path):
+    """ISSUE 19 acceptance: driving replay/audit/timeline off the
+    declared lifecycle machine produced byte-identical verdicts — this
+    pin holds the refactor (and all future ones) to that bar."""
+    from mpi_model_tpu.ensemble.journal import audit_journal, replay
+    from mpi_model_tpu.obs.postmortem import reconstruct
+
+    path = _golden_journal(tmp_path / "once", _GOLDEN_META)
+    audit = audit_journal(path)
+    audit.pop("path")
+    assert audit == {
+        "duplicate_terminals": [], "kinds": {"served": 2, "submit": 2},
+        "ok": True, "records": 4, "shed": 0, "submits": 2,
+        "terminal": 2, "torn": False, "unresolved": []}
+    st = replay(path)
+    assert (sorted(st.submits), sorted(st.terminal),
+            st.duplicate_terminals, st.shed, st.torn) == (
+        [0, 1], [0, 1], [], 0, False)
+    jd = str(tmp_path / "once")
+    assert _norm_tl(reconstruct(0, journal_dir=jd), path) == {
+        "complete": True, "gaps": [], "ticket": 0, "trace_id": None,
+        "events": [_jev("submit", 0, 10.0, "m0g0", "steps=4"),
+                   _jev("served", 2, 12.0, "m0g0", "steps=4")]}
+    assert _norm_tl(reconstruct(1, journal_dir=jd), path) == {
+        "complete": True, "gaps": [], "ticket": 1, "trace_id": None,
+        "events": [_jev("submit", 1, 11.0, "m1g0", "steps=4"),
+                   _jev("served", 3, 13.0, "m1g0", "steps=4")]}
+
+
+def test_golden_verdicts_torn_tail(tmp_path):
+    from mpi_model_tpu.ensemble.journal import audit_journal, replay
+    from mpi_model_tpu.obs.postmortem import reconstruct
+
+    path = _golden_journal(tmp_path / "torn", _GOLDEN_META[:1]
+                           + [("served", dict(_GOLDEN_META[2][1],
+                                              t_wall=11.0)),
+                              ("submit", dict(_GOLDEN_META[1][1],
+                                              t_wall=12.0))],
+                           tear=2)
+    audit = audit_journal(path)
+    audit.pop("path")
+    assert audit == {
+        "duplicate_terminals": [], "kinds": {"served": 1, "submit": 1},
+        "ok": True, "records": 2, "shed": 0, "submits": 1,
+        "terminal": 1, "torn": True, "unresolved": []}
+    st = replay(path)
+    assert (sorted(st.submits), sorted(st.terminal), st.torn) == (
+        [0], [0], True)
+    jd = str(tmp_path / "torn")
+    assert _norm_tl(reconstruct(0, journal_dir=jd), path) == {
+        "complete": True, "gaps": [], "ticket": 0, "trace_id": None,
+        "events": [_jev("submit", 0, 10.0, "m0g0", "steps=4"),
+                   _jev("served", 1, 11.0, "m0g0", "steps=4"),
+                   _TORN_NOTE, _TORN_TAIL]}
+    assert _norm_tl(reconstruct(1, journal_dir=jd), path) == {
+        "complete": False, "gaps": [_NO_SUBMIT], "ticket": 1,
+        "trace_id": None,
+        "events": [_NO_SUBMIT, _TORN_NOTE, _TORN_TAIL]}
+
+
+def test_golden_verdicts_duplicate_terminal(tmp_path):
+    from mpi_model_tpu.ensemble.journal import audit_journal, replay
+    from mpi_model_tpu.obs.postmortem import reconstruct
+
+    path = _golden_journal(tmp_path / "dup", [
+        _GOLDEN_META[0],
+        ("served", dict(_GOLDEN_META[2][1], t_wall=11.0)),
+        ("quarantined", {"ticket": 0, "service_id": "m0g0", "steps": 4,
+                         "error": "ValueError", "detail": "boom",
+                         "t_wall": 12.0})])
+    audit = audit_journal(path)
+    audit.pop("path")
+    assert audit == {
+        "duplicate_terminals": [0],
+        "kinds": {"quarantined": 1, "served": 1, "submit": 1},
+        "ok": False, "records": 3, "shed": 0, "submits": 1,
+        "terminal": 1, "torn": False, "unresolved": []}
+    assert replay(path).duplicate_terminals == [0]
+    jd = str(tmp_path / "dup")
+    assert _norm_tl(reconstruct(0, journal_dir=jd), path) == {
+        "complete": False, "gaps": [], "ticket": 0, "trace_id": None,
+        "events": [
+            _jev("submit", 0, 10.0, "m0g0", "steps=4"),
+            _jev("served", 1, 11.0, "m0g0", "steps=4"),
+            _jev("quarantined", 2, 12.0, "m0g0",
+                 "error=ValueError; detail=boom; steps=4")]}

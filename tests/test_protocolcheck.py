@@ -1,0 +1,207 @@
+"""Runtime protocol witness (ISSUE 19): the lifecycle machines applied
+to LIVE journal streams — the dynamic twin of the static protocol
+audit, arming/observing exactly the way lockdep does for locks."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mpi_model_tpu.ensemble.journal import TicketJournal
+from mpi_model_tpu.ensemble.lifecycle import (FLEET, SERVED, SHED,
+                                              SUBMIT, TIERING)
+from mpi_model_tpu.resilience import protocolcheck
+
+F = FLEET.journal_name        # tickets.journal
+T = TIERING.journal_name      # hibernation.journal
+
+
+def kinds_of(w):
+    return [v["kind"] for v in w.violations]
+
+
+# -- arming discipline --------------------------------------------------------
+
+def test_disarmed_is_inert():
+    assert protocolcheck.active() is None
+    # the seam is a no-op without a witness — nothing to record into
+    protocolcheck.journal_append(F, "meteor", {})
+    assert protocolcheck.active() is None
+
+
+def test_armed_exposes_witness_and_restores_on_exit():
+    with protocolcheck.armed() as w:
+        assert protocolcheck.active() is w
+    assert protocolcheck.active() is None
+
+
+def test_double_arming_is_refused():
+    with protocolcheck.armed():
+        with pytest.raises(RuntimeError, match="already armed"):
+            with protocolcheck.armed():
+                pass
+
+
+def test_armed_clears_even_when_body_raises():
+    with pytest.raises(ValueError):
+        with protocolcheck.armed():
+            raise ValueError("boom")
+    assert protocolcheck.active() is None
+
+
+# -- classification -----------------------------------------------------------
+
+def test_legal_lifecycle_is_clean_and_counted():
+    with protocolcheck.armed() as w:
+        w.observe(F, "submit", {"ticket": "t0"})
+        w.observe(F, "migrate", {"ticket": "t0"})
+        w.observe(F, "served", {"ticket": "t0"})
+    assert w.records == 3
+    assert w.violations == []
+    w.assert_clean()
+
+
+def test_illegal_transition_flagged():
+    with protocolcheck.armed() as w:
+        w.observe(F, "submit", {"ticket": "t0"})
+        w.observe(F, "submit", {"ticket": "t0"})  # in-flight ∉ sources
+    assert kinds_of(w) == ["illegal-transition"]
+    with pytest.raises(protocolcheck.ProtocolViolation,
+                       match="illegal-transition"):
+        w.assert_clean()
+
+
+def test_duplicate_terminal_flagged():
+    with protocolcheck.armed() as w:
+        w.observe(F, "submit", {"ticket": "t0"})
+        w.observe(F, "served", {"ticket": "t0"})
+        w.observe(F, "served", {"ticket": "t0"})
+    assert kinds_of(w) == ["duplicate-terminal"]
+
+
+def test_wake_without_commit_flagged():
+    # hibernate intent witnessed, commit never — a live wake out of
+    # "hibernating" is legal only through crash recovery's ladder
+    with protocolcheck.armed() as w:
+        w.observe(T, "hibernate", {"ticket": "t0"})
+        w.observe(T, "wake", {"ticket": "t0"})
+    assert kinds_of(w) == ["wake-without-commit"]
+
+
+def test_committed_hibernation_wake_is_clean():
+    with protocolcheck.armed() as w:
+        w.observe(T, "hibernate", {"ticket": "t0"})
+        w.observe(T, "hibernated", {"ticket": "t0"})
+        w.observe(T, "wake", {"ticket": "t0"})
+        w.observe(T, "reclaim", {"ticket": "t0"})
+    w.assert_clean()
+
+
+def test_undeclared_kind_flagged():
+    with protocolcheck.armed() as w:
+        w.observe(F, "meteor", {"ticket": "t0"})
+    assert kinds_of(w) == ["undeclared-kind"]
+
+
+def test_missing_ticket_flagged():
+    with protocolcheck.armed() as w:
+        w.observe(F, "submit", {})
+    assert kinds_of(w) == ["missing-ticket"]
+
+
+def test_ticketless_shed_is_clean():
+    # shed is declared ticketless: an overload drop has no ticket to
+    # track and must never read as missing-ticket
+    with protocolcheck.armed() as w:
+        w.observe(F, "shed", {"reason": "overload"})
+    assert w.records == 1
+    w.assert_clean()
+
+
+def test_adoption_on_first_sighting_mid_lifecycle():
+    # a witness armed around a recovery sees tickets mid-flight: adopt
+    # at the record's target, never guess about unseen history …
+    with protocolcheck.armed() as w:
+        w.observe(F, "served", {"ticket": "recovered"})
+        assert w.violations == []
+        # … but the adopted state is tracked: a second terminal IS a
+        # duplicate from where the witness now stands
+        w.observe(F, "served", {"ticket": "recovered"})
+    assert kinds_of(w) == ["duplicate-terminal"]
+
+
+def test_undeclared_stream_is_ignored():
+    with protocolcheck.armed() as w:
+        w.observe("delta.chain", "submit", {"ticket": "t0"})
+    assert w.records == 0
+    w.assert_clean()
+
+
+def test_violations_deduplicate():
+    with protocolcheck.armed() as w:
+        for _ in range(3):
+            w.observe(F, "meteor", {"ticket": "t0"})
+    assert w.records == 3
+    assert kinds_of(w) == ["undeclared-kind"]
+
+
+def test_one_bad_record_does_not_cascade():
+    # the state still advances past a flagged record, so the rest of a
+    # legal stream stays clean (one violation, not one per record)
+    with protocolcheck.armed() as w:
+        w.observe(F, "submit", {"ticket": "t0"})
+        w.observe(F, "submit", {"ticket": "t0"})
+        w.observe(F, "served", {"ticket": "t0"})
+    assert kinds_of(w) == ["illegal-transition"]
+
+
+# -- the journal seam ---------------------------------------------------------
+
+def test_ticket_journal_feeds_the_witness(tmp_path):
+    path = str(tmp_path / F)
+    with protocolcheck.armed() as w:
+        with TicketJournal(path) as j:
+            j.append(SUBMIT, {"ticket": "t0", "steps": 2})
+            j.append(SHED, {"reason": "overload"})
+            j.append(SERVED, {"ticket": "t0", "steps": 2})
+    assert w.records == 3
+    w.assert_clean()
+
+
+def test_ticket_journal_surfaces_live_duplicate_terminal(tmp_path):
+    path = str(tmp_path / F)
+    with protocolcheck.armed() as w:
+        with TicketJournal(path) as j:
+            j.append(SUBMIT, {"ticket": "t0"})
+            j.append(SERVED, {"ticket": "t0"})
+            j.append(SERVED, {"ticket": "t0"})
+    assert kinds_of(w) == ["duplicate-terminal"]
+
+
+def test_non_lifecycle_journal_not_witnessed(tmp_path):
+    # only the declared stream basenames are the witness's business
+    path = str(tmp_path / "audit.log")
+    with protocolcheck.armed() as w:
+        with TicketJournal(path) as j:
+            j.append("anything", {"x": 1})
+    assert w.records == 0
+    w.assert_clean()
+
+
+# -- the zero-cost contract ---------------------------------------------------
+
+def test_step_jaxpr_unchanged_with_protocolcheck_armed():
+    """Journals are host-side only: arming the witness cannot perturb a
+    traced step — the protocol twin of the lockdep/inject contract."""
+    from mpi_model_tpu import CellularSpace, Diffusion, Model
+
+    space = CellularSpace.create(8, 8, 1.0, dtype=jnp.float64)
+    sds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+           for k, v in space.values.items()}
+    clean = str(jax.make_jaxpr(
+        Model(Diffusion(0.1), 4.0, 1.0).make_step(space))(sds))
+    with protocolcheck.armed():
+        armed_jaxpr = str(jax.make_jaxpr(
+            Model(Diffusion(0.1), 4.0, 1.0).make_step(space))(sds))
+    assert armed_jaxpr == clean
