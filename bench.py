@@ -861,7 +861,23 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
     (``kill9_audit_ok``), on top of the PR 10 abandon-and-recover leg
     which also runs with process members. The bitwise preamble gate
     (process-served == the inproc synchronous scheduler) is the
-    process-mode-equals-inproc acceptance check."""
+    process-mode-equals-inproc acceptance check.
+
+    ``transport="tcp"`` (ISSUE 20 / BENCH_FLEET_r03) is the process
+    fleet with every member behind an authenticated TCP socket (HMAC
+    challenge–response before the first frame) instead of a unix
+    socketpair. Everything the process row proves runs again over TCP
+    — wire chaos plus a ``tcp_partition``, the REAL member kill -9, the
+    abandon-and-recover leg — behind a bitwise tcp-vs-unix preamble
+    gate. On top rides the SUPERVISOR failover leg: a journaled TCP
+    fleet owned by a NAMED supervisor is killed dead mid-soak (the
+    ``supervisor_kill`` seam: ticks stop, the journal handle stays
+    open — the zombie shape), a ``StandbySupervisor`` watching the
+    lease takes over under a new epoch, serves every ticket exactly
+    once (journal replay audit), the zombie's post-takeover append is
+    refused by the epoch fence, and ``obs.timeline`` is complete for
+    every ticket across the supervisor generation
+    (``failover_audit_ok`` / ``failover_zombie_fenced``)."""
     import numpy as np
     import jax.numpy as jnp
 
@@ -874,11 +890,11 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
 
     if services < 1:
         raise ValueError(f"services={services} must be >= 1")
-    if transport not in ("inproc", "process"):
+    if transport not in ("inproc", "process", "tcp"):
         raise ValueError(f"unknown transport {transport!r}")
-    if transport == "process" and services < 2:
+    if transport in ("process", "tcp") and services < 2:
         raise ValueError(
-            "transport='process' is the fleet row — run it with "
+            f"transport={transport!r} is the fleet row — run it with "
             "services >= 2 (--serve-services)")
 
     enable_compile_cache()
@@ -920,6 +936,34 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
         print(f"  service gate OK: {B} async lanes bitwise-equal to "
               f"sync at {grid}^2 {dtype_name}", file=sys.stderr)
 
+    # -- ISSUE 20 preamble gate (tcp only): the SAME batch served by
+    # real spawned members over authenticated TCP must be bitwise-equal
+    # to the r02 unix-socketpair fleet — the transport may never touch
+    # the numbers
+    if transport == "tcp":
+        gate_served = {}
+        for mt in ("process", "tcp"):
+            gf = FleetSupervisor(template, services=2,
+                                 member_transport=mt, **kwargs)
+            try:
+                gt = [gf.submit(pool_spaces[i], model=pool_models[i])
+                      for i in range(B)]
+                gate_served[mt] = [gf.result(t, timeout=600)[0]
+                                   for t in gt]
+            finally:
+                gf.stop()
+        for i in range(B):
+            a = np.asarray(gate_served["tcp"][i].values["value"])
+            b = np.asarray(gate_served["process"][i].values["value"])
+            if not np.array_equal(a, b):
+                raise AssertionError(
+                    f"tcp gate failed: scenario {i} served over TCP is "
+                    f"not bitwise-equal to the unix-socket fleet at "
+                    f"{grid}^2")
+        if verbose:
+            print(f"  tcp gate OK: {B} scenarios bitwise-equal across "
+                  "tcp and unix member transports", file=sys.stderr)
+
     # -- offered load: ~90% of the sync path's measured service rate
     gst = sync_gate.stats()
     per_scen = (gst["busy_s"] / gst["scenarios"]
@@ -937,7 +981,7 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
     # -- the async soak, chaos armed: transient + loop-level faults
     # spread through the run; every one must resolve to a counted
     # outcome (recovered / quarantined / shed / expired)
-    if transport == "process":
+    if transport in ("process", "tcp"):
         # ISSUE 13: member faults cannot fire inside a real child (the
         # chaos plan is armed in THIS process) — the wire seams are the
         # process fleet's fault surface, and proc_kill is a REAL
@@ -948,6 +992,12 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
                   offset=4, nbytes=8, tear="corrupt"),
             Fault("proc_kill", at=max(20, n_scenarios // 2)),
         ]
+        if transport == "tcp":
+            # ISSUE 20: a one-shot mid-soak TCP partition — the
+            # supervisor must read the dead wire as a MEMBER fault,
+            # fence and respawn, never a ticket outcome
+            faults.append(Fault("tcp_partition",
+                                at=max(16, 2 * n_scenarios // 5)))
     else:
         faults = [
             Fault("lane_nan", ticket=max(1, n_scenarios // 3), once=True),
@@ -970,8 +1020,8 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
     plan = FaultPlan(tuple(faults), seed=23) if chaos else FaultPlan(())
     if services > 1:
         fleet_kw = dict(kwargs)
-        if transport == "process":
-            fleet_kw.update(member_transport="process")
+        if transport in ("process", "tcp"):
+            fleet_kw.update(member_transport=transport)
         async_svc = FleetSupervisor(
             template, services=services, windows=windows,
             max_queue=max_queue, deadline_s=deadline_s,
@@ -1055,7 +1105,7 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
             "member_faults": async_rep["member_faults"],
             "readmitted": async_rep["readmitted"],
         }
-        if transport == "process":
+        if transport in ("process", "tcp"):
             # ISSUE 13 observability: the wire ledger of the soak
             # fleet (per-member attribution rides async_rep["services"])
             soak_st = async_svc.stats()
@@ -1070,7 +1120,7 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
         # respawns gen+1 and re-admits, every ticket resolves, and the
         # standalone journal audit proves exactly-once (no duplicate
         # terminals, nothing unresolved)
-        if transport == "process":
+        if transport in ("process", "tcp"):
             from mpi_model_tpu.ensemble.journal import audit_journal
 
             kdir = tempfile.mkdtemp(prefix="fleet-kill9-")
@@ -1079,7 +1129,7 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
                                  max_queue=max_queue, journal_dir=kdir,
                                  tick_interval_s=0.01,
                                  heartbeat_deadline_s=0.5,
-                                 member_transport="process", **kwargs)
+                                 member_transport=transport, **kwargs)
             kts = [kf.submit(pool_spaces[i % B],
                              model=pool_models[i % B], steps=steps)
                    for i in range(k9)]
@@ -1169,8 +1219,8 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
         rdir = tempfile.mkdtemp(prefix="fleet-journal-")
         k = min(4 * B, 32)
         rkw = dict(kwargs)
-        if transport == "process":
-            rkw["member_transport"] = "process"
+        if transport in ("process", "tcp"):
+            rkw["member_transport"] = transport
         rf = FleetSupervisor(template, services=services,
                              max_queue=max_queue, journal_dir=rdir,
                              tick_interval_s=0.01, **rkw)
@@ -1225,6 +1275,126 @@ def bench_service(grid: int = 512, B: int = 8, steps: int = 8,
         if verbose:
             print(f"  kill-restart: {k} tickets, {rerun} re-admitted "
                   f"after the kill, audit complete", file=sys.stderr)
+
+        # -- tcp-only: the SUPERVISOR failover leg (ISSUE 20
+        # acceptance, BENCH_FLEET_r03) — a journaled TCP fleet owned
+        # by a NAMED supervisor is serving when the supervisor_kill
+        # seam kills it dead mid-soak (ticks stop, lease decays, the
+        # journal handle stays OPEN: the zombie shape a real kill -9
+        # leaves behind). A StandbySupervisor tailing the lease must
+        # take over under epoch 2 within the lease bound, serve every
+        # ticket exactly once (journal replay audit), REFUSE the
+        # zombie's post-takeover append via the epoch fence, and hand
+        # obs.timeline a complete lifecycle for every ticket across
+        # the supervisor generation
+        if transport == "tcp":
+            import warnings as _warnings
+
+            from mpi_model_tpu.ensemble.fleet import StandbySupervisor
+            from mpi_model_tpu.ensemble.journal import (StaleEpochError,
+                                                        audit_journal)
+
+            fdir = tempfile.mkdtemp(prefix="fleet-failover-")
+            fo_n = min(4 * B, 24)
+            fo_lease = 0.75
+            f1 = FleetSupervisor(template, services=services,
+                                 max_queue=max_queue, journal_dir=fdir,
+                                 tick_interval_s=0.01,
+                                 supervisor_id="sup-a", lease_s=fo_lease,
+                                 member_transport="tcp", **kwargs)
+            fts = [f1.submit(pool_spaces[i % B],
+                             model=pool_models[i % B], steps=steps)
+                   for i in range(fo_n)]
+            stop_by = _t.monotonic() + 120.0
+            while (_t.monotonic() < stop_by
+                   and f1.counter.snapshot()["latency_n"] < fo_n // 3):
+                _t.sleep(0.005)  # under real load, then kill the owner
+            t_kill = _t.monotonic()
+            with armed(FaultPlan(
+                    (Fault("supervisor_kill", channel="sup-a"),))):
+                while _t.monotonic() < stop_by and not f1._stopped:
+                    _t.sleep(0.005)
+            if not f1._stopped:
+                raise AssertionError(
+                    "failover leg: supervisor_kill seam never fired")
+            sb = StandbySupervisor(fdir, template,
+                                   supervisor_id="sup-b",
+                                   services=services,
+                                   max_queue=max_queue,
+                                   tick_interval_s=0.01,
+                                   member_transport="tcp", **kwargs)
+            f2 = None
+            while _t.monotonic() < stop_by and f2 is None:
+                f2 = sb.poll()
+                if f2 is None:
+                    _t.sleep(0.02)
+            if f2 is None:
+                raise AssertionError(
+                    "failover leg: standby never took over a lease "
+                    f"that went stale at {fo_lease}s")
+            takeover_s = _t.monotonic() - t_kill
+            fo_served = 0
+            for t in fts:
+                try:
+                    f2.result(t, timeout=300)
+                    fo_served += 1
+                # analysis: ignore[broad-except] — per-ticket honesty:
+                # a counted failure is a ledger line, not a bench abort
+                except Exception:
+                    pass
+            # the zombie wakes up and tries to write: both its journal
+            # planes must refuse — the raw handle raises, the fleet's
+            # guarded append counts a rejection and writes NOTHING
+            fo_zombie_fenced = False
+            try:
+                f1.journal.append("shed", {"ticket": -1})
+            except StaleEpochError:
+                fo_zombie_fenced = True
+            with _warnings.catch_warnings():
+                _warnings.simplefilter("ignore", RuntimeWarning)
+                # the fleet's own guarded append path: refuses, counts
+                # a stale_epoch_rejection, writes nothing
+                f1._journal_append_locked("shed", {"ticket": -1})
+                f1.abandon()  # reaps the zombie's orphaned children
+            fo_rejections = f1.counter.snapshot()[
+                "stale_epoch_rejections"]
+            f2.stop()
+            fo_audit = audit_journal(journal_path(fdir))
+            fo_epochs = [e["epoch"] for e in fo_audit["epochs"]]
+            fo_incomplete = [
+                t for t in fts
+                if not _obs.timeline(t, journal_dir=fdir).complete]
+            failover_ok = (fo_audit["ok"] and not fo_audit["unresolved"]
+                           and fo_served == fo_n and fo_zombie_fenced
+                           and fo_rejections >= 1
+                           and fo_epochs == [1, 2]
+                           and fo_audit["epochs"][1]["takeover_from"]
+                           == "sup-a"
+                           and not fo_incomplete)
+            if not failover_ok:
+                raise AssertionError(
+                    f"failover leg failed: served {fo_served}/{fo_n}, "
+                    f"epochs={fo_epochs}, zombie_fenced="
+                    f"{fo_zombie_fenced}, rejections={fo_rejections}, "
+                    f"audit={fo_audit}, incomplete_timelines="
+                    f"{fo_incomplete}")
+            fleet_fields.update({
+                "failover_tickets": fo_n,
+                "failover_served": fo_served,
+                "failover_lease_s": fo_lease,
+                "failover_takeover_s": takeover_s,
+                "failover_epochs": fo_epochs,
+                "failover_zombie_fenced": fo_zombie_fenced,
+                "failover_stale_epoch_rejections": fo_rejections,
+                "failover_timeline_ok": not fo_incomplete,
+                "failover_audit_ok": bool(fo_audit["ok"]),
+            })
+            if verbose:
+                print(f"  failover: sup-a killed holding "
+                      f"{fo_n - fo_served} unresolved, sup-b took over "
+                      f"in {takeover_s:.2f}s (lease {fo_lease}s), "
+                      f"{fo_served}/{fo_n} served, zombie fenced, "
+                      "audit OK", file=sys.stderr)
     if verbose:
         print(f"  soak: {async_rep['sustained_scenarios_per_s']:.2f} "
               f"scen/s sustained (sync "
@@ -2225,7 +2395,10 @@ if __name__ == "__main__":
                  if a.startswith("--serve-services=")), 1)
             # --serve-transport=process (ISSUE 13): real spawned
             # member processes, wire chaos incl. a REAL kill -9 leg;
-            # persists as the round's BENCH_FLEET_r02 artifact
+            # persists as the round's BENCH_FLEET_r02 artifact.
+            # --serve-transport=tcp (ISSUE 20): the same fleet behind
+            # authenticated TCP members plus the supervisor-failover
+            # leg; persists as BENCH_FLEET_r03
             srv_transport = next(
                 (a.split("=", 1)[1] for a in sys.argv
                  if a.startswith("--serve-transport=")), "inproc")
@@ -2235,7 +2408,9 @@ if __name__ == "__main__":
             out_name = ("BENCH_SERVE_r01.json" if n_services == 1
                         else "BENCH_FLEET_r01.json"
                         if srv_transport == "inproc"
-                        else "BENCH_FLEET_r02.json")
+                        else "BENCH_FLEET_r02.json"
+                        if srv_transport == "process"
+                        else "BENCH_FLEET_r03.json")
             with open(out_name, "w") as fh:
                 json.dump(result, fh, indent=2)
                 fh.write("\n")

@@ -642,10 +642,18 @@ def check_naked_save(ctx: ModuleCtx):
 _SUBPROCESS_CALLS = {"Popen", "run", "call", "check_call", "check_output"}
 _SOCKET_CALLS = {"socket", "socketpair", "create_connection",
                  "create_server"}
+#: transport-AUTH primitives (ISSUE 20): the TCP members' shared-secret
+#: HMAC challenge–response and its secret minting live in the wire
+#: handshake — a module reaching for ``hmac``/``secrets`` elsewhere is
+#: hand-rolling a second, unaudited authentication path beside it
+_HMAC_CALLS = {"new", "compare_digest", "digest"}
+_SECRETS_CALLS = {"token_hex", "token_bytes", "token_urlsafe"}
 #: bare names that unambiguously mean a transport was opened even
-#: through a from-import ("run"/"call"/"socket" alone are too generic)
+#: through a from-import ("run"/"call"/"socket"/"new" alone are too
+#: generic)
 _TRANSPORT_BARE = {"Popen", "socketpair", "create_connection",
-                   "create_server"}
+                   "create_server", "compare_digest", "token_hex",
+                   "token_bytes", "token_urlsafe"}
 
 
 def _transport_boundary_module(ctx: ModuleCtx) -> bool:
@@ -657,9 +665,10 @@ def _transport_boundary_module(ctx: ModuleCtx) -> bool:
 
 
 @rule("raw-transport", Severity.ERROR,
-      "raw socket/subprocess use outside the ensemble wire boundary — "
-      "bytes crossing a process edge must ride the CRC-framed, "
-      "deadline-bounded codec (ensemble/wire.py, member_proc.py)",
+      "raw socket/subprocess/transport-auth use outside the ensemble "
+      "wire boundary — bytes crossing a process edge must ride the "
+      "CRC-framed, deadline-bounded codec, and its HMAC handshake is "
+      "the ONE auth path (ensemble/wire.py, member_proc.py)",
       scope=SCOPE_PACKAGE,
       fix_hint="send the bytes through the wire codec (ensemble/wire.py) "
       "or add the module to the transport boundary with a "
@@ -678,6 +687,10 @@ def check_raw_transport(ctx: ModuleCtx):
                 hit = f"subprocess.{fn.attr}"
             elif recv == "socket" and fn.attr in _SOCKET_CALLS:
                 hit = f"socket.{fn.attr}"
+            elif recv == "hmac" and fn.attr in _HMAC_CALLS:
+                hit = f"hmac.{fn.attr}"
+            elif recv == "secrets" and fn.attr in _SECRETS_CALLS:
+                hit = f"secrets.{fn.attr}"
         elif isinstance(fn, ast.Name) and fn.id in _TRANSPORT_BARE:
             hit = fn.id
         if hit is not None:

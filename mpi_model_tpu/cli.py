@@ -428,7 +428,8 @@ def _run_serve(args, space, model) -> int:
         rep = run_soak(svc, [(space, None, None)] * n,
                        arrival_rate_hz=rate,
                        snapshot_path=args.status,
-                       snapshot_interval_s=args.status_interval_s)
+                       snapshot_interval_s=args.status_interval_s,
+                       status_port=args.status_port)
     if args.trace:
         # serve mode: the merged ticket-flight trace (member spans
         # arrived over heartbeats, labeled m<slot>g<gen>)
@@ -474,7 +475,7 @@ def _run_serve(args, space, model) -> int:
             {k: s[k] for k in ("service_id", "scenarios", "dispatches",
                                "pending", "gen")}
             for s in rep["services"]]
-        if args.serve_transport == "process":
+        if args.serve_transport in ("process", "tcp"):
             st = svc.stats()
             for k in ("respawns", "heartbeats", "heartbeat_misses",
                       "wire_errors", "wire_bytes_in", "wire_bytes_out"):
@@ -631,11 +632,12 @@ def cmd_run(args) -> int:
             raise SystemExit(
                 "scenario tiering needs BOTH --residency-budget and "
                 "--hibernate-dir (or neither)")
-        if args.serve_member_env and args.serve_transport != "process":
+        if args.serve_member_env and args.serve_transport not in (
+                "process", "tcp"):
             raise SystemExit(
                 "--serve-member-env pins a spawned CHILD's environment "
                 "(device visibility); it needs "
-                "--serve-transport=process")
+                "--serve-transport=process or =tcp")
         if args.residency_budget is not None \
                 and args.residency_budget < 1:
             raise SystemExit(
@@ -653,7 +655,8 @@ def cmd_run(args) -> int:
                 ("--residency-budget", args.residency_budget, None),
                 ("--hibernate-dir", args.hibernate_dir, None),
                 ("--status", args.status, None),
-                ("--status-interval-s", args.status_interval_s, 5.0)):
+                ("--status-interval-s", args.status_interval_s, 5.0),
+                ("--status-port", args.status_port, None)):
             if val != default:
                 raise SystemExit(
                     f"{flag} configures the always-on serving loop; "
@@ -1019,14 +1022,19 @@ def main(argv: Optional[list[str]] = None) -> int:
                      "restart, per-member attribution); default 1 = "
                      "the single async loop")
     run.add_argument("--serve-transport", default="inproc",
-                     choices=("inproc", "process"),
+                     choices=("inproc", "process", "tcp"),
                      help="fleet member transport (ISSUE 13): "
                      "'inproc' (default) runs members as in-process "
                      "services; 'process' spawns each member as its "
                      "own OS process behind the CRC-framed wire "
                      "protocol (heartbeat health, fence + respawn on "
                      "a killed member, per-member device pinning via "
-                     "the child environment)")
+                     "the child environment); 'tcp' (ISSUE 20) is "
+                     "'process' over an authenticated TCP socket — a "
+                     "per-member shared secret rides the child env "
+                     "(MMTPU_WIRE_SECRET, never argv) and both sides "
+                     "run an HMAC challenge-response before the first "
+                     "frame, with jitter-tolerant deadline defaults")
     run.add_argument("--serve-member-env", action="append", default=None,
                      metavar="KEY=VAL",
                      help="with --serve-transport=process: lay KEY=VAL "
@@ -1138,6 +1146,14 @@ def main(argv: Optional[list[str]] = None) -> int:
                      metavar="S",
                      help="seconds between --status snapshot dumps "
                      "during a soak (default 5)")
+    run.add_argument("--status-port", type=int, default=None,
+                     metavar="PORT",
+                     help="with --serve: serve the live telemetry "
+                     "plane over HTTP for the soak's duration (ISSUE "
+                     "20) — GET /metrics is a Prometheus text "
+                     "exposition of the serving counters, GET "
+                     "/snapshot the full obs.fleet_snapshot JSON "
+                     "document; binds 127.0.0.1:PORT (0 = ephemeral)")
     run.add_argument("--json", action="store_true")
     run.set_defaults(fn=cmd_run)
 

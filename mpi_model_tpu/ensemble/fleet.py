@@ -62,7 +62,10 @@ injectable clock — zero wall sleeps.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import itertools
+import json
+import os
 import threading
 import time
 import warnings
@@ -74,16 +77,42 @@ from ..resilience import inject, lockdep
 from ..utils.metrics import ThroughputCounter
 from ..utils.tracing import TraceContext, get_tracer
 from .batch import structure_key
-from .journal import (TicketJournal, journal_path, model_from_meta,
-                      model_meta, replay, space_from_record, space_payload)
+from .journal import (StaleEpochError, TicketJournal, declare_epoch,
+                      journal_path, model_from_meta, model_meta, replay,
+                      space_from_record, space_payload)
 from .lifecycle import (EXPIRED, MIGRATE, QUARANTINED, READMIT, SERVED,
                         SHED, SUBMIT, WAKE)
+from .member_proc import resolve_deadlines, spawn_process_member
 from .scheduler import TicketExpired, TicketNotMigratable
 from .service import AsyncEnsembleService, ServiceOverloaded
 from .tiering import HibernationError, ScenarioTiering, scenario_nbytes
 from .wire import WireError
 
-__all__ = ["AutoscalePolicy", "FleetSupervisor", "MemberFailure"]
+__all__ = ["AutoscalePolicy", "FleetSupervisor", "MemberFailure",
+           "StandbySupervisor", "lease_path", "read_lease"]
+
+#: the supervisor lease file inside a journal directory (ISSUE 20):
+#: JSON ``{"owner", "epoch", "t", "lease_s"}`` rewritten atomically on
+#: every supervision tick by the ACTIVE supervisor. A standby that
+#: observes the stamp going stale past ``lease_s`` (on the SHARED
+#: injectable clock — ``time.monotonic`` is host-wide on Linux, so
+#: same-host processes compare directly) takes the fleet over.
+LEASE_NAME = "supervisor.lease"
+
+
+def lease_path(journal_dir: str) -> str:
+    return os.path.join(journal_dir, LEASE_NAME)
+
+
+def read_lease(path: str) -> Optional[dict]:
+    """The lease record, or None when the file is missing or garbled
+    (a torn lease write is a missed renewal, never a crash)."""
+    try:
+        with open(path) as fh:
+            rec = json.load(fh)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -193,8 +222,12 @@ class FleetSupervisor:
                  poll_interval_s: float = 0.02,
                  member_transport: str = "inproc",
                  member_spawner: Optional[Callable] = None,
-                 heartbeat_deadline_s: float = 2.0,
-                 rpc_deadline_s: float = 30.0,
+                 member_host: str = "127.0.0.1",
+                 heartbeat_deadline_s: Optional[float] = None,
+                 rpc_deadline_s: Optional[float] = None,
+                 supervisor_id: Optional[str] = None,
+                 lease_s: float = 2.0,
+                 takeover_from: Optional[str] = None,
                  member_env: Optional[dict] = None,
                  residency_budget: Optional[int] = None,
                  hibernate_dir: Optional[str] = None,
@@ -206,10 +239,10 @@ class FleetSupervisor:
             raise ValueError(
                 f"services={services} exceeds the policy's max_services="
                 f"{policy.max_services}")
-        if member_transport not in ("inproc", "process"):
+        if member_transport not in ("inproc", "process", "tcp"):
             raise ValueError(
                 f"unknown member_transport {member_transport!r} "
-                "(expected 'inproc' or 'process')")
+                "(expected 'inproc', 'process' or 'tcp')")
         #: ISSUE 13: "inproc" (the default — in-process
         #: AsyncEnsembleService members, behaviorally identical to
         #: PR 10) or "process" — members behind the ensemble.wire
@@ -227,8 +260,13 @@ class FleetSupervisor:
         #: the ISSUE 16 N-single-chip-members layout); a callable gets
         #: the slot and returns the env.
         self._transport = member_transport
-        self._heartbeat_deadline = float(heartbeat_deadline_s)
-        self._rpc_deadline = float(rpc_deadline_s)
+        #: ISSUE 20 — deadlines default per transport: TCP members ride
+        #: real network jitter (handshake RTT, kernel backlog), so their
+        #: heartbeats/RPCs get the retuned wire.TCP_* bounds; unix/local
+        #: keep the tight PR-13 values. An explicit float always wins.
+        self._heartbeat_deadline, self._rpc_deadline = resolve_deadlines(
+            "tcp" if member_transport == "tcp" else "unix",
+            heartbeat_deadline_s, rpc_deadline_s)
         if (member_env is not None and not isinstance(member_env, dict)
                 and not callable(member_env)):
             member_env = [dict(e) if e else {} for e in member_env]
@@ -238,16 +276,26 @@ class FleetSupervisor:
                     "for no pinning)")
         self._member_env = member_env
         self._spawner = member_spawner
-        if member_transport == "process":
+        if member_transport in ("process", "tcp"):
             if self._spawner is None:
-                from .member_proc import spawn_process_member
-
-                self._spawner = spawn_process_member
+                #: ISSUE 20 — "tcp" is "process" over an authenticated
+                #: TCP socket: spawn_process_member mints a per-member
+                #: shared secret (child env only), listens on an
+                #: ephemeral ``member_host`` port, and both sides run
+                #: the wire.py HMAC challenge–response before the first
+                #: frame. Cross-HOST members are spawned by an external
+                #: launcher and handed in via ``member_spawner``.
+                self._spawner = (
+                    functools.partial(spawn_process_member,
+                                      transport="tcp", host=member_host)
+                    if member_transport == "tcp"
+                    else spawn_process_member)
             if model_meta(model) is None:
                 raise ValueError(
-                    "member_transport='process' needs a template model "
-                    "model_meta() can serialize (scalar-field flows); "
-                    "this model has no wire recipe")
+                    f"member_transport={member_transport!r} needs a "
+                    "template model model_meta() can serialize "
+                    "(scalar-field flows); this model has no wire "
+                    "recipe")
         self.model = model
         self.default_steps = (int(member_kwargs["steps"])
                               if member_kwargs.get("steps") is not None
@@ -284,8 +332,31 @@ class FleetSupervisor:
         self.counter = ThroughputCounter()
         self.journal: Optional[TicketJournal] = None
         self._journal_results = bool(journal_results)
+        #: ISSUE 20 — supervisor identity + failover state. A NAMED
+        #: supervisor (``supervisor_id``) is one competing for the
+        #: fleet: it declares a fresh journal epoch at startup (fencing
+        #: every older handle) and renews ``supervisor.lease`` each
+        #: tick so a StandbySupervisor can detect its death. Anonymous
+        #: supervisors (the default) keep the PR-10 single-owner
+        #: journal semantics: no epoch stamps, no lease.
+        self._supervisor_id = supervisor_id
+        self._lease_s = float(lease_s)
+        self._lease_path: Optional[str] = None
+        if supervisor_id is not None and journal_dir is None:
+            raise ValueError(
+                "supervisor_id needs journal_dir: failover is fenced "
+                "through the journal's epoch file and lease")
         if journal_dir is not None:
-            self.journal = TicketJournal(journal_path(journal_dir))
+            if supervisor_id is not None:
+                self.journal = TicketJournal(journal_path(journal_dir),
+                                             epoch=0)
+                declare_epoch(self.journal, supervisor=supervisor_id,
+                              takeover_from=takeover_from,
+                              lease_s=self._lease_s)
+                self._lease_path = lease_path(journal_dir)
+                self._renew_lease()
+            else:
+                self.journal = TicketJournal(journal_path(journal_dir))
         #: ISSUE 14 — fleet-level scenario tiering: when every member
         #: refuses (or the fleet residency budget is exhausted) a
         #: submission HIBERNATES to the vault instead of shedding;
@@ -386,6 +457,14 @@ class FleetSupervisor:
                 rpc_deadline_s=self._rpc_deadline,
                 member_env=self._member_env_for(slot),
                 pump_mode="thread" if self._threaded else "rpc")
+        if (self.journal is not None and self.journal.epoch is not None
+                and hasattr(svc, "epoch")):
+            # ISSUE 20 — arm the member-side fence: every RPC this
+            # client sends is stamped with the supervisor's epoch, so a
+            # member inherited by a newer supervisor refuses the
+            # zombie's frames (the server ratchets to the highest epoch
+            # it has seen and errs anything lower)
+            svc.epoch = self.journal.epoch
         if gen > 0:
             # observability: how many times this fleet replaced a
             # member in place (fence → gen+1)
@@ -498,6 +577,28 @@ class FleetSupervisor:
                 if self._stop_flag:
                     return
                 self._cv.wait(self._tick_interval)
+
+    def _renew_lease(self) -> None:
+        """Re-stamp ``supervisor.lease`` (atomic tmp+replace — a reader
+        sees the old record or the new one, never a torn write). Runs
+        at the top of every tick; a write failure is a missed renewal
+        (counted, survived) — the standby treats it like a death, which
+        is the safe direction."""
+        if self._lease_path is None:
+            return
+        rec = {"owner": self._supervisor_id,
+               "epoch": (self.journal.epoch
+                         if self.journal is not None else None),
+               "t": self._clock(), "lease_s": self._lease_s}
+        tmp = self._lease_path + ".tmp"
+        try:
+            with open(tmp, "w") as fh:
+                fh.write(json.dumps(rec))
+            os.replace(tmp, self._lease_path)
+        except OSError as e:
+            self.counter.bump("loop_faults")
+            warnings.warn(f"supervisor lease renewal failed: {e} — a "
+                          "standby may take over", RuntimeWarning)
 
     # -- client surface ------------------------------------------------------
 
@@ -786,6 +887,28 @@ class FleetSupervisor:
         is heartbeat-RPCed (deadline-bounded, outside the fleet lock —
         a slow wire must not stall submit/poll), refreshing the cached
         telemetry the locked phase then reads."""
+        if self._supervisor_id is not None:
+            st = inject.active()
+            if st is not None and st.member_fault(
+                    self._supervisor_id, ("supervisor_kill",),
+                    site="lease", count=True) is not None:
+                # ISSUE 20 — the simulated ``kill -9`` of the ACTIVE
+                # supervisor: supervision stops DEAD mid-soak. The
+                # flags are set inline (abandon() would join the
+                # supervisor thread — the thread we are ON); the
+                # journal handle stays OPEN, exactly like a zombie
+                # process that still holds its fd — the failover bench
+                # asserts the epoch fence rejects its next append
+                self.counter.bump("supervisor_kills")
+                get_recorder().record("supervisor_kill",
+                                      service_id=self._supervisor_id)
+                with self._cv:
+                    self._abandoned = True
+                    self._stop_flag = True
+                    self._stopped = True
+                    self._cv.notify_all()
+                return
+            self._renew_lease()
         self._heartbeat_members()
         with self._cv:
             if self._abandoned:
@@ -935,6 +1058,17 @@ class FleetSupervisor:
             # latency escapes are journal_results=False (metadata-only
             # terminals) or journal_dir=None, both regression-tested
             self.journal.append(kind, meta, arrays)
+        except StaleEpochError as e:
+            # ISSUE 20 — the epoch fence fired: a NEWER supervisor owns
+            # this journal, so this one is a zombie whose append wrote
+            # NOTHING. Counted separately from loop_faults (the bench's
+            # failover leg asserts the rejection happened) — and unlike
+            # an I/O fault this is not transient: every later append
+            # from this handle is equally fenced.
+            self.counter.bump("stale_epoch_rejections")
+            warnings.warn(
+                f"fleet journal append ({kind}) fenced: {e} — this "
+                "supervisor was superseded; stop it", RuntimeWarning)
         except (OSError, ValueError) as e:
             self.counter.bump("loop_faults")
             warnings.warn(
@@ -1851,6 +1985,13 @@ class FleetSupervisor:
                 # ISSUE 13 observability: the wire transport's ledger
                 # (all zero for inproc fleets)
                 "member_transport": self._transport,
+                # ISSUE 20: supervisor identity + failover ledger
+                # (anonymous supervisors: id/epoch None, counters zero)
+                "supervisor_id": self._supervisor_id,
+                "epoch": (self.journal.epoch
+                          if self.journal is not None else None),
+                "supervisor_kills": snap["supervisor_kills"],
+                "stale_epoch_rejections": snap["stale_epoch_rejections"],
                 "respawns": snap["respawns"],
                 "heartbeats": snap["heartbeats"],
                 "heartbeat_misses": snap["heartbeat_misses"],
@@ -1880,3 +2021,100 @@ class FleetSupervisor:
                    if self.tiering is not None else {}),
                 "services": per,
             }
+
+
+class StandbySupervisor:
+    """The failover watcher (ISSUE 20): tails a fleet's journal
+    directory — ``supervisor.lease`` plus the TJ1 journal — WITHOUT
+    owning any member, and takes the fleet over when the active
+    supervisor's lease goes stale.
+
+    The protocol, end to end:
+
+    1. The ACTIVE (named) supervisor re-stamps the lease every
+       supervision tick on the SHARED clock (``time.monotonic`` is
+       host-wide on Linux, so same-host processes compare directly;
+       fake-clock tests inject one clock into both sides).
+    2. The standby polls ``should_takeover()``: the lease's age
+       exceeding its own ``lease_s`` (or the lease vanishing under an
+       existing journal) means the active stopped ticking — dead,
+       wedged, or partitioned; all three read the same and all three
+       are grounds to fence it.
+    3. ``takeover()`` runs ``FleetSupervisor.recover`` under THIS
+       standby's ``supervisor_id``: the new fleet declares journal
+       epoch N+1 (fence file first, EPOCH record second), re-admits
+       every unresolved ticket exactly once, and stamps its frames
+       with the new epoch.
+    4. The OLD supervisor, if it was merely wedged and wakes up a
+       zombie, is fenced twice over: its journal appends raise
+       :class:`~.journal.StaleEpochError` (writing nothing) and its
+       member RPCs come back ``err`` — it can corrupt neither the
+       ledger nor the members.
+
+    ``lease_s=None`` (the default) honors the lease's OWN advertised
+    ``lease_s`` — the active supervisor declares how fast it promises
+    to tick; pass a float to override the staleness bound."""
+
+    def __init__(self, journal_dir: str, model, *,
+                 supervisor_id: str,
+                 lease_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 **fleet_kwargs):
+        self.journal_dir = journal_dir
+        self.model = model
+        self.supervisor_id = supervisor_id
+        self._lease_s = lease_s
+        self._clock = clock
+        self._fleet_kwargs = dict(fleet_kwargs)
+        #: the fleet built by takeover(), None while standing by
+        self.fleet: Optional[FleetSupervisor] = None
+
+    def lease(self) -> Optional[dict]:
+        return read_lease(lease_path(self.journal_dir))
+
+    def lease_age(self) -> Optional[float]:
+        """Seconds since the active's last renewal, or None when no
+        lease file exists (never written, or deleted)."""
+        rec = self.lease()
+        if rec is None or not isinstance(rec.get("t"), (int, float)):
+            return None
+        return self._clock() - rec["t"]
+
+    def should_takeover(self) -> bool:
+        if self.fleet is not None:
+            return False  # already took over
+        age = self.lease_age()
+        if age is None:
+            # no lease at all: a journal without one means a PRE-lease
+            # supervisor (or a crash before the first stamp) — claim
+            # it; no journal means there is nothing to supervise yet
+            return os.path.exists(journal_path(self.journal_dir))
+        rec = self.lease() or {}
+        bound = self._lease_s
+        if bound is None:
+            bound = rec.get("lease_s") or 2.0
+        return age > bound
+
+    def takeover(self) -> FleetSupervisor:
+        """Fence the stale active and become THE supervisor: recover
+        the fleet from the journal under this standby's id — epoch
+        N+1 is declared before any member spawns, so the zombie is
+        fenced from the first instant of the new generation."""
+        prev = self.lease() or {}
+        self.fleet = FleetSupervisor.recover(
+            self.journal_dir, self.model,
+            supervisor_id=self.supervisor_id,
+            takeover_from=prev.get("owner"),
+            clock=self._clock,
+            **({"lease_s": self._lease_s}
+               if self._lease_s is not None else {}),
+            **self._fleet_kwargs)
+        return self.fleet
+
+    def poll(self) -> Optional[FleetSupervisor]:
+        """One standby beat: take over iff the lease is stale. Call it
+        from a timer/loop; returns the new fleet on the beat that
+        fired, else None."""
+        if self.should_takeover():
+            return self.takeover()
+        return None

@@ -27,6 +27,10 @@ kind            written when / carries
 ``readmit``/
 ``migrate``     non-terminal attribution: a ticket moved to another
                 member (fencing, retirement, crash-restart recovery)
+``epoch``       a supervisor declared ownership of the stream — first
+                start or failover takeover (ISSUE 20): the sidecar
+                fence file moved first, so appends from any older
+                epoch's handle raise ``StaleEpochError`` from then on
 ==============  =============================================================
 
 Record format (the PR 5/6 checkpoint discipline applied to a log):
@@ -64,13 +68,16 @@ import numpy as np
 from ..core.cellular_space import CellularSpace
 from ..models.model import Model
 from ..resilience import inject, protocolcheck
-from .lifecycle import FLEET, SHED, SUBMIT, TERMINAL_KINDS
+from .lifecycle import EPOCH, FLEET, SHED, SUBMIT, TERMINAL_KINDS
 from .wire import encode_payload, parse_payload
 
 __all__ = [
     "audit_journal",
+    "current_epoch",
+    "declare_epoch",
     "fold_records",
     "main",
+    "StaleEpochError",
     "TicketJournal",
     "JournalRecord",
     "JournalState",
@@ -122,13 +129,56 @@ class JournalRecord:
         return self.meta.get("ticket")
 
 
+class StaleEpochError(ValueError):
+    """A journal append was fenced: the handle's supervisor epoch is
+    older than the fence file's — a standby took over while this
+    (zombie) supervisor still held an open handle. The append wrote
+    NOTHING; the zombie must stop, never retry (ISSUE 20)."""
+
+
+#: sidecar fence-file suffix: ``<journal>.epoch`` holds the current
+#: supervisor epoch as ASCII digits, written atomically (tmp + rename)
+#: BEFORE the matching ``epoch`` record — a crash between the two
+#: over-bumps the fence (harmless) but never leaves a declared epoch
+#: unfenced
+_EPOCH_SUFFIX = ".epoch"
+
+
+def current_epoch(path: str) -> int:
+    """The fence: the highest supervisor epoch ever declared over this
+    journal (0 when no supervisor has declared one — pre-ISSUE-20
+    journals and epoch-less tests)."""
+    try:
+        with open(path + _EPOCH_SUFFIX, "rb") as fh:
+            return int(fh.read().strip() or b"0")
+    except (FileNotFoundError, ValueError):
+        return 0
+
+
+def _write_fence(path: str, epoch: int) -> None:
+    tmp = path + _EPOCH_SUFFIX + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(b"%d\n" % epoch)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path + _EPOCH_SUFFIX)
+
+
 class TicketJournal:
     """Append handle over one journal file. NOT internally locked: the
     fleet serializes every append under its own supervisor lock (the
-    journal is a seam of the fleet, not a shared service)."""
+    journal is a seam of the fleet, not a shared service).
 
-    def __init__(self, path: str):
+    ``epoch`` (ISSUE 20) opts the handle into the supervisor fence:
+    every append first checks the sidecar fence file and raises
+    :class:`StaleEpochError` — writing nothing — once a later
+    supervisor has declared a higher epoch, and every record written
+    carries the handle's epoch in its meta. ``epoch=None`` (the
+    default) keeps the pre-failover behaviour: no check, no stamp."""
+
+    def __init__(self, path: str, epoch: Optional[int] = None):
         self.path = path
+        self.epoch = epoch
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._count = 0
         if os.path.exists(path) and os.path.getsize(path) > 0:
@@ -155,8 +205,28 @@ class TicketJournal:
         ``t_wall`` (epoch seconds) at append time — the ordering anchor
         ``obs.timeline`` joins journal records against wall-anchored
         spans with (record INDEX stays the authoritative order within
-        one journal; the stamp is for cross-source merges)."""
+        one journal; the stamp is for cross-source merges).
+
+        An epoch-fenced handle (``epoch`` set at open) re-reads the
+        sidecar fence BEFORE writing and raises
+        :class:`StaleEpochError` if a later supervisor has taken over —
+        the zombie-supervisor write lands nowhere, not even torn. The
+        ``stale_epoch_append`` chaos seam makes THIS append behave as a
+        one-epoch-older zombie's, exercising the fence without a real
+        failover."""
+        if self.epoch is not None:
+            effective = self.epoch
+            if inject.stale_epoch_append(self.path):
+                effective -= 1
+            fence = current_epoch(self.path)
+            if effective < fence:
+                raise StaleEpochError(
+                    f"append fenced: handle epoch {effective} < "
+                    f"journal fence {fence} (a newer supervisor owns "
+                    f"{self.path})")
         body = dict(meta or {})
+        if self.epoch is not None:
+            body.setdefault("epoch", self.epoch)
         body["kind"] = kind
         body.setdefault("t_wall", time.time())
         # ONE payload format for the journal and the fleet wire
@@ -187,6 +257,29 @@ class TicketJournal:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def declare_epoch(journal: TicketJournal, *, supervisor: str,
+                  takeover_from: Optional[str] = None,
+                  lease_s: Optional[float] = None) -> int:
+    """Bump the fence and append the matching ``epoch`` audit record —
+    what a supervisor does at first start and what a standby does at
+    takeover (ISSUE 20). The fence file moves FIRST (atomic rename),
+    so from the instant a takeover is durable, every append from the
+    previous epoch's still-open handles raises
+    :class:`StaleEpochError`; the journal record is the human/audit
+    half, carrying who took over and from whom. Returns the new epoch
+    and re-arms ``journal`` to it."""
+    new = current_epoch(journal.path) + 1
+    _write_fence(journal.path, new)
+    journal.epoch = new
+    journal.append(EPOCH, {
+        "epoch": new,
+        "supervisor": supervisor,
+        "takeover_from": takeover_from,
+        "lease_s": lease_s,
+    })
+    return new
 
 
 def _parse_record(index: int, payload: bytes) -> JournalRecord:
@@ -250,6 +343,15 @@ class JournalState:
     shed: int
     #: the file had a torn tail (the suffix was discarded)
     torn: bool
+    #: supervisor-generation history (ISSUE 20): the meta of every
+    #: ``epoch`` record in stream order — who owned the journal, when,
+    #: and whom they took over from
+    epochs: list = dataclasses.field(default_factory=list)
+    #: indices of records stamped with an epoch OLDER than the highest
+    #: epoch declared before them in the stream — a zombie write the
+    #: fence should have refused (must stay empty; the audit fails on
+    #: any)
+    stale_epoch_records: list = dataclasses.field(default_factory=list)
 
     def unresolved(self) -> list[int]:
         """Tickets submitted but never resolved — what recovery
@@ -276,8 +378,20 @@ def fold_records(records: list, torn: bool) -> JournalState:
     terminal: dict = {}
     dup: list = []
     shed = 0
+    epochs: list = []
+    stale: list = []
+    declared = 0
     for rec in records:
-        if rec.kind == SUBMIT:
+        # epoch-fence audit (ISSUE 20): a record stamped with an epoch
+        # below the highest declared BEFORE it in the stream is a
+        # zombie write the fence should have refused
+        stamped = rec.meta.get("epoch")
+        if stamped is not None and stamped < declared:
+            stale.append(rec.index)
+        if rec.kind == EPOCH:
+            epochs.append(rec.meta)
+            declared = max(declared, rec.meta["epoch"])
+        elif rec.kind == SUBMIT:
             submits[rec.ticket] = rec
         elif FLEET.is_terminal(rec.kind):
             if rec.ticket in terminal:
@@ -287,7 +401,8 @@ def fold_records(records: list, torn: bool) -> JournalState:
         elif rec.kind == SHED:
             shed += 1
     return JournalState(submits=submits, terminal=terminal,
-                        duplicate_terminals=dup, shed=shed, torn=torn)
+                        duplicate_terminals=dup, shed=shed, torn=torn,
+                        epochs=epochs, stale_epoch_records=stale)
 
 
 # -- scenario (space/model) serialization -------------------------------------
@@ -395,7 +510,14 @@ def audit_journal(path: str, _records: Optional[list] = None,
         "shed": state.shed,
         "unresolved": state.unresolved(),
         "duplicate_terminals": list(state.duplicate_terminals),
-        "ok": not state.duplicate_terminals,
+        "epochs": [
+            {"epoch": m["epoch"], "supervisor": m.get("supervisor"),
+             "takeover_from": m.get("takeover_from"),
+             "lease_s": m.get("lease_s"), "t_wall": m.get("t_wall")}
+            for m in state.epochs],
+        "stale_epoch_records": list(state.stale_epoch_records),
+        "ok": (not state.duplicate_terminals
+               and not state.stale_epoch_records),
     }
 
 
@@ -407,8 +529,9 @@ def main(argv: Optional[list] = None) -> int:
     ``FleetSupervisor.recover`` replays it. ``--json`` emits the audit
     dict on one line; exit 1 when the audit finds duplicate terminals
     (a ticket resolved twice — the invariant recovery must never
-    break), 0 otherwise (a torn tail or unresolved tickets are
-    REPORTED, not fatal: they are the normal crash shape)."""
+    break) or stale-epoch appends (a zombie supervisor's write got
+    past the fence), 0 otherwise (a torn tail or unresolved tickets
+    are REPORTED, not fatal: they are the normal crash shape)."""
     import argparse
     import sys
 
@@ -444,12 +567,21 @@ def main(argv: Optional[list] = None) -> int:
         print(f"-- {audit['records']} verified records "
               f"({', '.join(f'{k}={v}' for k, v in sorted(audit['kinds'].items()))})"
               + ("; TORN TAIL discarded" if audit["torn"] else ""))
+        for e in audit["epochs"]:
+            src = ("first start" if e["takeover_from"] is None
+                   else f"took over from {e['takeover_from']}")
+            print(f"-- epoch {e['epoch']}: supervisor="
+                  f"{e['supervisor']} ({src}, lease_s={e['lease_s']})")
+        if audit["stale_epoch_records"]:
+            print(f"-- STALE-EPOCH APPENDS (zombie writes past the "
+                  f"fence): records {audit['stale_epoch_records']}")
         print(f"-- audit: submits={audit['submits']} "
               f"terminal={audit['terminal']} shed={audit['shed']} "
               f"unresolved={audit['unresolved']} "
               f"duplicate_terminals={audit['duplicate_terminals']}")
-        print("-- exactly-once: " + ("OK" if audit["ok"] else
-                                     "FAILED (duplicate terminals)"))
+        print("-- exactly-once: " + (
+            "OK" if audit["ok"] else
+            "FAILED (duplicate terminals or stale-epoch appends)"))
     return 0 if audit["ok"] else 1
 
 

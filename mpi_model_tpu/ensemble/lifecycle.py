@@ -52,6 +52,7 @@ import dataclasses
 from typing import Optional
 
 __all__ = [
+    "EPOCH",
     "EVENT_KINDS",
     "EXPIRED",
     "FLEET",
@@ -86,6 +87,10 @@ SHED = "shed"
 READMIT = "readmit"
 MIGRATE = "migrate"
 WAKE = "wake"
+#: fleet stream — supervisor-generation audit record (ISSUE 20): a
+#: supervisor (first start or failover takeover) declaring it now owns
+#: the stream; appends stamped with a LOWER epoch are fenced
+EPOCH = "epoch"
 #: tiering stream — the hibernate/wake paging lifecycle
 HIBERNATE = "hibernate"      # intent (written BEFORE the chain write)
 HIBERNATED = "hibernated"    # commit (the chain record verified on disk)
@@ -97,8 +102,10 @@ TERMINAL_KINDS = (SERVED, QUARANTINED, EXPIRED)
 
 #: meta keys EVERY record carries regardless of kind: ``kind``/``t_wall``
 #: are stamped by ``TicketJournal.append``, ``arrays`` (the per-array
-#: CRC table) by the shared TJ1/TW1 payload codec when state rides along
-STAMPED_META = ("kind", "t_wall", "arrays")
+#: CRC table) by the shared TJ1/TW1 payload codec when state rides along,
+#: and ``epoch`` by an epoch-fenced journal handle (ISSUE 20 — absent on
+#: journals opened without a supervisor epoch)
+STAMPED_META = ("kind", "t_wall", "arrays", "epoch")
 
 #: every ``resilience.FailureEvent.kind`` the package constructs (the
 #: supervisor docstring's taxonomy, now machine-checked by the
@@ -218,6 +225,9 @@ FLEET = LifecycleMachine(
                    meta=("ticket", "from", "to", "reason")),
         Transition(WAKE, ("in-flight",), "in-flight",
                    meta=("ticket", "to")),
+        Transition(EPOCH, (), INITIAL, ticketless=True,
+                   meta=("epoch", "supervisor", "takeover_from",
+                         "lease_s")),
     ),
 )
 

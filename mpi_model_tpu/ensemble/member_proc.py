@@ -16,7 +16,10 @@ the service tier:
 - :func:`main` — the spawned-process entrypoint
   (``python -m mpi_model_tpu.ensemble.member_proc``): builds its model
   from the journal recipe, its service from a JSON config, connects
-  back to the supervisor's unix socket and serves. The child owns its
+  back to the supervisor's unix socket — or, in the ISSUE 20
+  multi-host mode, dials a ``host:port`` TCP address and authenticates
+  through the mutual HMAC handshake (secret via ``$MMTPU_WIRE_SECRET``,
+  never argv) — and serves. The child owns its
   DEVICES through the environment the spawner set before ``exec``
   (``JAX_PLATFORMS`` / ``CUDA_VISIBLE_DEVICES`` / ``TPU_VISIBLE_*`` —
   jax reads them at import, which happens entirely inside the child)
@@ -75,11 +78,16 @@ from .journal import model_from_meta, model_meta, space_payload
 from .scheduler import (EnsembleScheduler, TicketExpired,
                         TicketNotMigratable)
 from .service import AsyncEnsembleService, ServiceOverloaded
-from .wire import TRACE_META_KEY, FrameConn, RemoteError, WireError
+from .wire import (SECRET_ENV, TCP_HEARTBEAT_DEADLINE_S,
+                   TCP_RPC_DEADLINE_S, TRACE_META_KEY, FrameConn,
+                   HandshakeError, RemoteError, WireError,
+                   client_handshake, serve_handshake, tcp_dial,
+                   tcp_listener)
 
 __all__ = [
     "MemberServer",
     "ProcessMemberClient",
+    "resolve_deadlines",
     "spawn_process_member",
     "spawn_loopback_member",
     "main",
@@ -217,6 +225,11 @@ class MemberServer:
         self._lock = threading.Lock()
         self._pump_dead = False
         self._stopping = False
+        #: highest supervisor epoch seen on any request frame
+        #: (ISSUE 20): once a takeover's frames arrive, the zombie
+        #: supervisor's lower-epoch frames get a typed ``err`` reply —
+        #: the member-side half of the journal's epoch fence
+        self._epoch = 0
         #: True only when the supervisor's shutdown RPC ended serving —
         #: the entrypoint's exit-code contract reads it (a lost wire is
         #: NOT a clean shutdown)
@@ -281,6 +294,25 @@ class MemberServer:
                        deadline_s=self.REPLY_DEADLINE_S)
 
     def _handle(self, kind: str, meta: dict, arrays) -> bool:
+        # epoch fence (ISSUE 20): requests stamped with a supervisor
+        # epoch ratchet the member's high-water mark; a frame from an
+        # OLDER epoch is a zombie supervisor's — refuse it with a typed
+        # reply (the zombie must stop, the member must not double-serve)
+        frame_epoch = meta.get("epoch")
+        if frame_epoch is not None:
+            with self._lock:
+                if frame_epoch < self._epoch:
+                    stale = self._epoch
+                else:
+                    stale = None
+                    self._epoch = frame_epoch
+            if stale is not None:
+                self._reply("err", {
+                    "error": "StaleEpochError",
+                    "detail": f"frame epoch {frame_epoch} < member's "
+                              f"fenced epoch {stale} (a newer "
+                              "supervisor owns this member)"})
+                return False
         try:
             if kind == "submit":
                 return self._handle_submit(meta, arrays)
@@ -677,6 +709,11 @@ class ProcessMemberClient:
         self._telemetry: dict = {}
         self._last_beat = clock()
         self._killed = False
+        #: supervisor epoch stamped into every request frame when set
+        #: (ISSUE 20): the fleet arms it from its journal epoch, so a
+        #: member that has seen a takeover's frames refuses this
+        #: client's if it belongs to a fenced (zombie) supervisor
+        self.epoch: Optional[int] = None
         self.scheduler = _RemoteScheduler(self)
         # first beat fills the telemetry so routing/health have a cut
         # to read before the first supervision tick
@@ -696,6 +733,9 @@ class ProcessMemberClient:
         with self._lock:
             deadline = (self._rpc_deadline if deadline_s is None
                         else deadline_s)
+            if self.epoch is not None:
+                meta = dict(meta or {})
+                meta.setdefault("epoch", self.epoch)
             self._conn.send(kind, meta, arrays, deadline_s=deadline)
             return self._conn.recv(deadline_s=deadline)
 
@@ -778,7 +818,9 @@ class ProcessMemberClient:
                 return False
         try:
             with self._lock:
-                self._conn.send("heartbeat", {},
+                beat_meta = ({} if self.epoch is None
+                             else {"epoch": self.epoch})
+                self._conn.send("heartbeat", beat_meta,
                                 deadline_s=self._rpc_deadline)
                 kind, meta, _ = self._conn.recv(
                     deadline_s=self._rpc_deadline)
@@ -974,10 +1016,29 @@ def _decode_member_kwargs(cfg: dict) -> dict:
     return out
 
 
+def resolve_deadlines(transport: str,
+                      heartbeat_deadline_s: Optional[float],
+                      rpc_deadline_s: Optional[float]
+                      ) -> tuple[float, float]:
+    """The per-transport deadline defaults (ISSUE 20): ``None`` means
+    "the transport's default" — 2s/30s on the latency-free local
+    transports (unix socket, loopback, in-proc), the jitter-tolerant
+    ``wire.TCP_*`` pair on tcp. An explicit float always wins."""
+    if heartbeat_deadline_s is None:
+        heartbeat_deadline_s = (TCP_HEARTBEAT_DEADLINE_S
+                                if transport == "tcp" else 2.0)
+    if rpc_deadline_s is None:
+        rpc_deadline_s = (TCP_RPC_DEADLINE_S if transport == "tcp"
+                          else 30.0)
+    return float(heartbeat_deadline_s), float(rpc_deadline_s)
+
+
 def spawn_process_member(model, *, service_id: str, member_kwargs: dict,
                          clock: Callable[[], float] = time.monotonic,
-                         heartbeat_deadline_s: float = 2.0,
-                         rpc_deadline_s: float = 30.0,
+                         transport: str = "unix",
+                         host: str = "127.0.0.1",
+                         heartbeat_deadline_s: Optional[float] = None,
+                         rpc_deadline_s: Optional[float] = None,
                          member_env: Optional[dict] = None,
                          pump_mode: str = "thread",
                          python: Optional[str] = None
@@ -994,7 +1055,21 @@ def spawn_process_member(model, *, service_id: str, member_kwargs: dict,
     never silently fight its parent for the same accelerator. The
     child's persistent compile cache is ``member_kwargs[
     "compile_cache"]`` (default "auto": the shared machine cache, so a
-    respawned gen+1 re-uses gen's executables)."""
+    respawned gen+1 re-uses gen's executables).
+
+    ``transport="tcp"`` (ISSUE 20) is the multi-host mode: the
+    supervisor listens on ``host:<ephemeral>``, a fresh per-member
+    shared secret crosses to the child IN ITS ENVIRONMENT
+    (``wire.SECRET_ENV`` — never on the command line, where any local
+    ``ps`` would read it), and the accepted connection must pass the
+    mutual HMAC handshake before the first frame is parsed. Heartbeat
+    and RPC deadlines default per transport (see
+    :func:`resolve_deadlines`)."""
+    if transport not in ("unix", "tcp"):
+        raise ValueError(f"unknown member transport {transport!r} "
+                         "(expected 'unix' or 'tcp')")
+    heartbeat_deadline_s, rpc_deadline_s = resolve_deadlines(
+        transport, heartbeat_deadline_s, rpc_deadline_s)
     recipe = model_meta(model)
     if recipe is None:
         raise ValueError(
@@ -1008,14 +1083,22 @@ def spawn_process_member(model, *, service_id: str, member_kwargs: dict,
         "pump": pump_mode,
     }
     spawn_dir = tempfile.mkdtemp(prefix=f"mm-member-{service_id}-")
-    addr = os.path.join(spawn_dir, "sock")
     cfg_path = os.path.join(spawn_dir, "config.json")
     with open(cfg_path, "w") as fh:
         json.dump(cfg, fh)
-    listener = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
-    try:
+    secret = None
+    if transport == "tcp":
+        import secrets as _secrets
+
+        secret = _secrets.token_hex(32)
+        listener = tcp_listener(host, 0)
+        addr = "%s:%d" % listener.getsockname()[:2]
+    else:
+        addr = os.path.join(spawn_dir, "sock")
+        listener = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
         listener.bind(addr)
         listener.listen(1)
+    try:
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         # dtype fidelity across the boundary: the child must read the
@@ -1029,6 +1112,8 @@ def spawn_process_member(model, *, service_id: str, member_kwargs: dict,
         except (ImportError, AttributeError):  # pragma: no cover
             pass
         env.update(member_env or {})
+        if secret is not None:
+            env[SECRET_ENV] = secret
         proc = subprocess.Popen(
             [python or sys.executable, "-m",
              "mpi_model_tpu.ensemble.member_proc",
@@ -1042,6 +1127,14 @@ def spawn_process_member(model, *, service_id: str, member_kwargs: dict,
             raise WireError(
                 f"member {service_id} did not connect within "
                 f"{SPAWN_CONNECT_TIMEOUT_S}s of spawn")
+        if secret is not None:
+            # authenticate BEFORE any frame: a wrong-secret or wedged
+            # peer is closed here and the spawn fails loudly
+            try:
+                serve_handshake(sock, secret, chaos_id=service_id)
+            except HandshakeError:
+                proc.kill()
+                raise
     finally:
         listener.close()
     return ProcessMemberClient(
@@ -1094,18 +1187,44 @@ def spawn_loopback_member(model, *, service_id: str, member_kwargs: dict,
 
 # -- the spawned-process entrypoint -------------------------------------------
 
+def _dial_supervisor(addr: str) -> _socket.socket:
+    """Connect back to the spawner: a ``host:port`` address (numeric
+    port after the last colon — ISSUE 20's multi-host mode) dials TCP
+    and runs the client half of the HMAC handshake with the secret the
+    spawner placed in this process's environment (``wire.SECRET_ENV``);
+    anything else is a unix socket path."""
+    host, sep, port = addr.rpartition(":")
+    if sep and host and port.isdigit():
+        secret = os.environ.get(SECRET_ENV)
+        if not secret:
+            raise HandshakeError(
+                f"tcp connect to {addr} needs the shared secret in "
+                f"${SECRET_ENV} (the spawner sets it; it never rides "
+                "the command line)")
+        sock = tcp_dial(addr)
+        client_handshake(sock, secret)
+        return sock
+    sock = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    sock.connect(addr)
+    return sock
+
+
 def main(argv: Optional[list] = None) -> int:
     """``python -m mpi_model_tpu.ensemble.member_proc --connect <sock>
     --config <json>``: build the member service from its config and
-    serve the supervisor until shutdown. Exit codes: 0 = clean
-    shutdown, 2 = bad config, 1 = wire lost before shutdown (the
-    supervisor died or fenced us — either way nobody is listening)."""
+    serve the supervisor until shutdown. ``--connect`` is a unix
+    socket path or a ``host:port`` TCP address (the multi-host mode —
+    the wire secret must already be in ``$MMTPU_WIRE_SECRET``). Exit
+    codes: 0 = clean shutdown, 2 = bad config, 1 = wire lost (or
+    refused at the handshake) before shutdown — the supervisor died,
+    fenced us, or we failed its challenge."""
     import argparse
 
     p = argparse.ArgumentParser(
         prog="python -m mpi_model_tpu.ensemble.member_proc")
     p.add_argument("--connect", required=True,
-                   help="unix socket path the supervisor listens on")
+                   help="unix socket path or host:port TCP address "
+                        "the supervisor listens on")
     p.add_argument("--config", required=True,
                    help="member config JSON path (service_id, model "
                         "recipe, member_kwargs, pump mode)")
@@ -1123,8 +1242,12 @@ def main(argv: Optional[list] = None) -> int:
         print(f"member config failed: {type(e).__name__}: {e}",
               file=sys.stderr)
         return 2
-    sock = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
-    sock.connect(args.connect)
+    try:
+        sock = _dial_supervisor(args.connect)
+    except (WireError, OSError) as e:
+        print(f"member connect failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
     server = MemberServer(service, FrameConn(sock), pump=pump)
     # ignore SIGTERM politeness: the fleet's protocol is the shutdown
     # RPC; anything harder is SIGKILL, which nothing catches anyway

@@ -844,7 +844,8 @@ def run_soak(service, scenarios, *, arrival_rate_hz: float,
              clock: Callable[[], float] = time.monotonic,
              sleep: Callable[[float], None] = time.sleep,
              snapshot_path: Optional[str] = None,
-             snapshot_interval_s: float = 5.0) -> dict:
+             snapshot_interval_s: float = 5.0,
+             status_port: Optional[int] = None) -> dict:
     """Open-loop soak: submit ``scenarios`` (``(space, model, steps)``
     triples; model/steps may be None for the service defaults) at a
     fixed arrival rate — arrivals do NOT wait for completions, so a
@@ -863,10 +864,31 @@ def run_soak(service, scenarios, *, arrival_rate_hz: float,
     snapshot (``obs.write_snapshot`` — atomic tmp+rename) there every
     ``snapshot_interval_s`` of injectable-clock time during the soak,
     and once at the end — bench rows, chaos tests and a human watching
-    the file all consume the SAME plane."""
+    the file all consume the SAME plane.
+
+    ``status_port`` (ISSUE 20): also stand up the LIVE scrape endpoint
+    (``obs.serve_status`` — ``GET /metrics`` Prometheus, ``GET /`` the
+    snapshot JSON, computed fresh per request) for the soak's
+    duration; torn down before the report returns. Port 0 binds an
+    ephemeral port. Independent of ``snapshot_path`` — the endpoint
+    scrapes the live service, not the dumped file."""
     if arrival_rate_hz <= 0:
         raise ValueError(
             f"arrival_rate_hz={arrival_rate_hz} must be positive")
+    if status_port is not None:
+        from .. import obs
+
+        server = obs.serve_status(
+            status_port, lambda: obs.fleet_snapshot(service))
+        try:
+            return run_soak(service, scenarios,
+                            arrival_rate_hz=arrival_rate_hz,
+                            clock=clock, sleep=sleep,
+                            snapshot_path=snapshot_path,
+                            snapshot_interval_s=snapshot_interval_s)
+        finally:
+            server.shutdown()
+            server.server_close()
 
     def dump_snapshot() -> None:
         if snapshot_path is None:

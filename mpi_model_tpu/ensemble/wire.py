@@ -31,12 +31,25 @@ Hard rules, all typed and all tested (``tests/test_wire.py``):
   MEMBER fault (fence, respawn, recover tickets from the journal) —
   a broken wire is a dead machine, not a dead ticket.
 
+Since ISSUE 20 the codec also rides TCP: :func:`tcp_listener`/
+:func:`tcp_dial` put the SAME frames on a network socket, gated by a
+mutual HMAC-SHA256 challenge–response at accept
+(:func:`serve_handshake`/:func:`client_handshake`, shared secret via
+the :data:`SECRET_ENV` child-env contract) — a wrong secret, a
+truncated exchange or a peer slower than :data:`HANDSHAKE_DEADLINE_S`
+raises :class:`HandshakeError` and closes the socket BEFORE any frame
+is parsed. TCP deadlines are retuned for network jitter
+(:data:`TCP_HEARTBEAT_DEADLINE_S`/:data:`TCP_RPC_DEADLINE_S`).
+
 Chaos (``resilience.inject``): ``wire_torn`` tears/corrupts one
 outgoing frame at this seam — ``tear="corrupt"`` flips bytes so the
 receiver's CRC check fires immediately; ``tear="truncate"`` sends the
 frame's prefix and CLOSES the connection (the realistic
 crash-mid-write shape), so the receiver sees ``WireClosed``, not an
-unbounded wait. The seam costs one module-global read when disarmed.
+unbounded wait. ``tcp_partition`` makes one send/recv behave as a
+network partition (conn closed, ``WireTimeout``); ``handshake_fail``
+garbles one handshake proof so the peer must refuse. Every seam costs
+one module-global read when disarmed.
 
 This module's socket use is a deliberate BOUNDARY: the
 ``raw-transport`` analysis rule flags raw ``socket``/``subprocess``
@@ -47,6 +60,8 @@ CRC-checked and deadline-bounded).
 
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmac
 import json
 import socket as _socket
 import time
@@ -61,15 +76,24 @@ __all__ = [
     "WireError",
     "WireTimeout",
     "WireClosed",
+    "HandshakeError",
     "RemoteError",
     "FrameConn",
     "encode_payload",
     "parse_payload",
     "frame",
+    "serve_handshake",
+    "client_handshake",
+    "tcp_listener",
+    "tcp_dial",
     "REQUEST_KINDS",
     "REPLY_KINDS",
     "MAX_FRAME_BYTES",
     "TRACE_META_KEY",
+    "SECRET_ENV",
+    "HANDSHAKE_DEADLINE_S",
+    "TCP_HEARTBEAT_DEADLINE_S",
+    "TCP_RPC_DEADLINE_S",
 ]
 
 _MAGIC = b"TW1 "
@@ -119,6 +143,13 @@ class WireTimeout(WireError):
 class WireClosed(WireError):
     """The peer closed (EOF) — mid-frame or between frames. A member
     process that died mid-write lands here."""
+
+
+class HandshakeError(WireError):
+    """The accept-time HMAC challenge–response failed (wrong secret,
+    truncated/garbled exchange, or a peer slower than the handshake
+    deadline). The socket is CLOSED before any frame is parsed — an
+    unauthenticated peer never reaches the codec."""
 
 
 class RemoteError(RuntimeError):
@@ -219,6 +250,195 @@ def frame(payload: bytes) -> bytes:
     return header + payload + b"\n"
 
 
+# -- accept-time authentication + TCP (ISSUE 20) ------------------------------
+
+#: env var a spawned member reads its shared wire secret from (the
+#: spawner generates a per-fleet secret and lays it into the child env
+#: — never on the command line, where ``ps`` would show it)
+SECRET_ENV = "MMTPU_WIRE_SECRET"
+
+#: handshake wall budget: generous against real network jitter, small
+#: enough that a port-scanner holding a socket open cannot park a
+#: listener thread for long
+HANDSHAKE_DEADLINE_S = 5.0
+
+#: jitter-tolerant TCP deadline retunes (the unix-socket defaults —
+#: 2 s heartbeats, 30 s RPCs — assume same-host latency; a real network
+#: hiccup must read as jitter, not member death)
+TCP_HEARTBEAT_DEADLINE_S = 5.0
+TCP_RPC_DEADLINE_S = 60.0
+
+_HS_MAGIC = b"TWA1 "
+#: ``b"TWA1 " + 32-hex nonce + b"\n"`` — each side's challenge
+_HS_CHALLENGE_LEN = len(_HS_MAGIC) + 32 + 1
+#: ``b"TWA1 " + 64-hex digest + b" " + 32-hex nonce + b"\n"`` — the
+#: client's proof-of-secret plus its own counter-challenge
+_HS_REPLY_LEN = len(_HS_MAGIC) + 64 + 1 + 32 + 1
+#: ``b"TWA1 " + 64-hex digest + b"\n"`` — the server's proof
+_HS_PROOF_LEN = len(_HS_MAGIC) + 64 + 1
+
+
+def _hs_digest(secret: str, role: bytes, nonce: bytes) -> bytes:
+    """HMAC-SHA256 over ``role + b":" + nonce`` — the role tag makes
+    the two directions' proofs distinct, so a reflected server
+    challenge can never double as the client's answer."""
+    return _hmac.new(secret.encode(), role + b":" + nonce,
+                     hashlib.sha256).hexdigest().encode()
+
+
+def _hs_read(sock, n: int, t_end: float, *, what: str) -> bytes:
+    """Read exactly ``n`` handshake bytes before ``t_end`` or raise
+    :class:`HandshakeError` (truncated exchange / slow peer)."""
+    chunks: list = []
+    total = 0
+    while total < n:
+        # analysis: ignore[naked-timer] — socket-deadline arithmetic
+        # (remaining budget for settimeout), not timing
+        remaining = t_end - time.monotonic()
+        if remaining <= 0:
+            raise HandshakeError(
+                f"handshake {what} incomplete at its deadline "
+                f"({total}/{n} bytes) — peer too slow")
+        sock.settimeout(remaining)
+        try:
+            chunk = sock.recv(n - total)
+        except _socket.timeout as e:
+            raise HandshakeError(
+                f"handshake {what} incomplete at its deadline "
+                f"({total}/{n} bytes) — peer too slow") from e
+        except OSError as e:
+            raise HandshakeError(f"handshake {what} failed: {e}") from e
+        if not chunk:
+            raise HandshakeError(
+                f"peer closed during handshake {what} "
+                f"({total}/{n} bytes)")
+        chunks.append(chunk)
+        total += len(chunk)
+    return b"".join(chunks)
+
+
+def _hs_nonce_of(line: bytes, *, what: str) -> bytes:
+    if line[:len(_HS_MAGIC)] != _HS_MAGIC or line[-1:] != b"\n":
+        raise HandshakeError(f"malformed handshake {what} {line[:8]!r}")
+    return line[len(_HS_MAGIC):-1]
+
+
+def _hs_maybe_garbled(digest: bytes, chaos_id: Optional[str]) -> bytes:
+    """The ``handshake_fail`` chaos seam: a live fault aimed at
+    ``chaos_id`` garbles this side's proof, so the PEER must refuse and
+    close (one global read when disarmed)."""
+    st = inject.active()
+    if st is None:
+        return digest
+    f = st.member_fault(chaos_id, ("handshake_fail",),
+                        site="handshake", count=True)
+    if f is None:
+        return digest
+    return bytes(reversed(digest))
+
+
+def serve_handshake(sock, secret: str,
+                    deadline_s: float = HANDSHAKE_DEADLINE_S,
+                    chaos_id: Optional[str] = None) -> None:
+    """Authenticate an accepted connection (server side) via a mutual
+    HMAC-SHA256 challenge–response before ANY frame is parsed:
+    challenge the peer, verify its proof, then prove ourselves against
+    its counter-challenge. Any failure — wrong secret, truncated or
+    malformed exchange, a peer slower than ``deadline_s`` — raises
+    :class:`HandshakeError` and CLOSES the socket, so an
+    unauthenticated peer never reaches the frame codec."""
+    import secrets as _secrets
+
+    # analysis: ignore[naked-timer] — handshake deadline arithmetic
+    t_end = time.monotonic() + float(deadline_s)
+    try:
+        nonce = _secrets.token_hex(16).encode()
+        sock.settimeout(deadline_s)
+        sock.sendall(_HS_MAGIC + nonce + b"\n")
+        reply = _hs_read(sock, _HS_REPLY_LEN, t_end, what="reply")
+        body = _hs_nonce_of(reply, what="reply")
+        proof, sep, peer_nonce = body.partition(b" ")
+        if not sep or len(peer_nonce) != 32:
+            raise HandshakeError("malformed handshake reply")
+        want = _hs_digest(secret, b"client", nonce)
+        if not _hmac.compare_digest(proof, want):
+            raise HandshakeError(
+                "peer failed the challenge (wrong wire secret)")
+        ours = _hs_maybe_garbled(
+            _hs_digest(secret, b"server", peer_nonce), chaos_id)
+        sock.sendall(_HS_MAGIC + ours + b"\n")
+    except (HandshakeError, OSError) as e:
+        try:
+            sock.close()
+        except OSError:  # pragma: no cover - close is best-effort
+            pass
+        if isinstance(e, HandshakeError):
+            raise
+        raise HandshakeError(f"handshake failed: {e}") from e
+
+
+def client_handshake(sock, secret: str,
+                     deadline_s: float = HANDSHAKE_DEADLINE_S,
+                     chaos_id: Optional[str] = None) -> None:
+    """The dialing side of :func:`serve_handshake`: answer the
+    listener's challenge, counter-challenge it, verify its proof. Same
+    failure contract — :class:`HandshakeError`, socket closed, no frame
+    ever parsed on an unauthenticated stream."""
+    import secrets as _secrets
+
+    # analysis: ignore[naked-timer] — handshake deadline arithmetic
+    t_end = time.monotonic() + float(deadline_s)
+    try:
+        challenge = _hs_read(sock, _HS_CHALLENGE_LEN, t_end,
+                             what="challenge")
+        nonce = _hs_nonce_of(challenge, what="challenge")
+        if len(nonce) != 32:
+            raise HandshakeError("malformed handshake challenge")
+        ours = _hs_maybe_garbled(
+            _hs_digest(secret, b"client", nonce), chaos_id)
+        my_nonce = _secrets.token_hex(16).encode()
+        sock.settimeout(deadline_s)
+        sock.sendall(_HS_MAGIC + ours + b" " + my_nonce + b"\n")
+        proof_line = _hs_read(sock, _HS_PROOF_LEN, t_end, what="proof")
+        proof = _hs_nonce_of(proof_line, what="proof")
+        want = _hs_digest(secret, b"server", my_nonce)
+        if not _hmac.compare_digest(proof, want):
+            raise HandshakeError(
+                "listener failed the counter-challenge (wrong wire "
+                "secret)")
+    except (HandshakeError, OSError) as e:
+        try:
+            sock.close()
+        except OSError:  # pragma: no cover - close is best-effort
+            pass
+        if isinstance(e, HandshakeError):
+            raise
+        raise HandshakeError(f"handshake failed: {e}") from e
+
+
+def tcp_listener(host: str = "127.0.0.1", port: int = 0):
+    """A listening TCP socket for member accept — ``port=0`` lets the
+    OS pick (the spawner reads the bound port back). Part of the
+    sanctioned transport boundary the ``raw-transport`` rule pins."""
+    srv = _socket.create_server((host, port))
+    return srv
+
+
+def tcp_dial(addr: str, deadline_s: float = HANDSHAKE_DEADLINE_S):
+    """Dial a ``host:port`` member address (IPv6 hosts may be
+    bracketed); raises :class:`WireClosed` when the peer is
+    unreachable within ``deadline_s``."""
+    host, sep, port = addr.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(f"not a host:port address: {addr!r}")
+    host = host.strip("[]") or "127.0.0.1"
+    try:
+        return _socket.create_connection((host, int(port)),
+                                         timeout=float(deadline_s))
+    except OSError as e:
+        raise WireClosed(f"dial {addr} failed: {e}") from e
+
+
 # -- the connection -----------------------------------------------------------
 
 class FrameConn:
@@ -259,6 +479,7 @@ class FrameConn:
         data = frame(encode_payload(body, arrays))
         st = inject.active()
         if st is not None:
+            self._maybe_partitioned(st)
             f = st.member_fault(self.chaos_id, ("wire_torn",),
                                 site="wire", count=False)
             if f is not None:
@@ -266,6 +487,21 @@ class FrameConn:
                 return
         self._sendall(data, deadline_s)
         self.bytes_out += len(data)
+
+    def _maybe_partitioned(self, st) -> None:
+        """The ``tcp_partition`` chaos seam (ISSUE 20): a live fault
+        aimed at this conn makes the operation behave as a network
+        partition — the conn closes and the call raises
+        :class:`WireTimeout`, exactly what a real partition looks like
+        at the RPC deadline (the fleet must classify it a member
+        fault and fence)."""
+        f = st.member_fault(self.chaos_id, ("tcp_partition",),
+                            site="wire", count=False)
+        if f is not None:
+            self.close()
+            raise WireTimeout(
+                "injected tcp partition: peer unreachable at the "
+                "deadline")
 
     def _send_torn(self, data: bytes, fault) -> None:
         """The ``wire_torn`` chaos seam: ``corrupt`` flips ``nbytes``
@@ -311,6 +547,9 @@ class FrameConn:
         still in flight would otherwise pair with the NEXT request —
         so the no-retries contract is enforced structurally, not by
         caller discipline."""
+        st = inject.active()
+        if st is not None:
+            self._maybe_partitioned(st)
         try:
             return self._recv(deadline_s)
         except WireError:

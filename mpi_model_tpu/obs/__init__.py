@@ -25,6 +25,12 @@ versioned JSON document:
   every ``ThroughputCounter`` counter (plus the latency/occupancy
   gauges), per-member labeled ``{service_id="m<slot>g<gen>"}`` — for
   scrape-based collection without teaching a collector our JSON.
+- :func:`serve_status` — a LIVE scrape endpoint over both shapes
+  (ISSUE 20 satellite): a stdlib HTTP server on a daemon thread
+  answering ``GET /metrics`` with the Prometheus text and ``GET /``
+  with the snapshot JSON, each computed fresh per request.
+  ``run_soak(status_port=...)`` and the CLI ``--status-port`` flag
+  stand one up beside a live soak.
 - :func:`timeline` (``obs.timeline``) — post-mortem per-ticket
   timeline reconstruction joining the fleet journal, the tiering
   lifecycle journal and exported span files, with EXPLICIT
@@ -52,6 +58,7 @@ __all__ = [
     "get_recorder",
     "jsonable",
     "prometheus_text",
+    "serve_status",
     "set_recorder",
     "timeline",
     "validate_snapshot",
@@ -204,6 +211,69 @@ def prometheus_text(stats: dict) -> str:
         for label, v in by_name[k]:
             lines.append(f"{name}{label} {v}")
     return "\n".join(lines) + "\n"
+
+
+def serve_status(port: int, snapshot_fn, host: str = "127.0.0.1"):
+    """Stand up a LIVE scrape endpoint (ISSUE 20 satellite): a stdlib
+    ``ThreadingHTTPServer`` on a daemon thread answering
+
+    - ``GET /metrics`` — :func:`prometheus_text` of the CURRENT stats
+      cut (``snapshot_fn()`` runs per request, so a scraper always
+      sees live counters, not a stale dump);
+    - ``GET /`` (or ``/snapshot``) — the full snapshot JSON document.
+
+    ``snapshot_fn`` is any zero-arg callable returning a snapshot-
+    shaped dict (usually ``lambda: fleet_snapshot(service)``; the
+    operator CLI's ``--serve`` passes a file re-reader instead). A
+    failing ``snapshot_fn`` answers 500 with the error named — a
+    scrape must see the failure, not a hang. Pass ``port=0`` for an
+    ephemeral port; the bound one is ``server.server_address[1]``.
+    Returns the started server; call ``.shutdown()`` then
+    ``.server_close()`` to stop it."""
+    import http.server
+    import threading
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def _send(self, code: int, ctype: str, body: bytes) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            if path not in ("/", "/snapshot", "/metrics"):
+                self._send(404, "text/plain; charset=utf-8",
+                           b"unknown path (try / or /metrics)\n")
+                return
+            try:
+                doc = snapshot_fn()
+                if path == "/metrics":
+                    body = prometheus_text(
+                        doc.get("stats", {})).encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                else:
+                    body = json.dumps(doc).encode()
+                    ctype = "application/json"
+            # analysis: ignore[broad-except] — scrape isolation: a
+            # failing snapshot_fn (a stopped fleet, a torn file) must
+            # answer 500, not kill the serving thread
+            except Exception as e:
+                self._send(500, "text/plain; charset=utf-8",
+                           f"snapshot failed: {e!r}\n".encode())
+                return
+            self._send(200, ctype, body)
+
+        def log_message(self, *a):  # scrapes are not operator events
+            pass
+
+    server = http.server.ThreadingHTTPServer((host, port), _Handler)
+    server.daemon_threads = True
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="obs-status-server")
+    t.start()
+    return server
 
 
 def jsonable(x):

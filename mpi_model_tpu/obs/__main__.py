@@ -8,10 +8,15 @@ telemetry plane (ISSUE 15):
   a collector our JSON);
 - ``timeline <ticket> --journal DIR [--vault DIR] [--trace FILE]`` —
   reconstruct one ticket's lifecycle from the journals and an exported
-  Chrome trace; ``--json`` emits the timeline document, otherwise a
-  human-ordered listing. Exit 1 when the timeline is INCOMPLETE
-  (no submit, or no/duplicate terminal) — the post-mortem acceptance
-  predicate, scriptable.
+  span file (Chrome trace or streaming JSONL); ``--json`` emits the
+  timeline document, otherwise a human-ordered listing. Exit 1 when
+  the timeline is INCOMPLETE (no submit, or no/duplicate terminal) —
+  the post-mortem acceptance predicate, scriptable.
+- ``--serve PORT --snapshot FILE`` (ISSUE 20) — stand up the live
+  scrape endpoint over a snapshot file a soak keeps rewriting
+  (``run_soak(snapshot_path=...)``): ``GET /metrics`` is the
+  Prometheus exposition, ``GET /`` the snapshot JSON, each re-reading
+  the file per request. Blocks until interrupted.
 """
 
 from __future__ import annotations
@@ -21,7 +26,7 @@ import json
 import sys
 from typing import Optional
 
-from . import prometheus_text, validate_snapshot
+from . import prometheus_text, serve_status, validate_snapshot
 from .postmortem import reconstruct
 
 
@@ -30,8 +35,15 @@ def main(argv: Optional[list] = None) -> int:
         prog="python -m mpi_model_tpu.obs",
         description="Telemetry-plane CLI: snapshot validation, "
                     "Prometheus exposition, per-ticket timeline "
-                    "reconstruction.")
-    sub = p.add_subparsers(dest="cmd", required=True)
+                    "reconstruction, live scrape serving.")
+    p.add_argument("--serve", type=int, default=None, metavar="PORT",
+                   help="serve the live scrape endpoint on PORT "
+                        "(requires --snapshot; no subcommand)")
+    p.add_argument("--snapshot", default=None, metavar="FILE",
+                   help="snapshot file to serve (re-read per request)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address for --serve (default loopback)")
+    sub = p.add_subparsers(dest="cmd", required=False)
 
     v = sub.add_parser("validate", help="schema-gate a snapshot file")
     v.add_argument("snapshot")
@@ -48,10 +60,43 @@ def main(argv: Optional[list] = None) -> int:
     t.add_argument("--vault", default=None,
                    help="tiering vault directory (hibernation journal)")
     t.add_argument("--trace", default=None,
-                   help="exported Chrome trace (export_chrome output)")
+                   help="exported span file: a Chrome trace "
+                        "(export_chrome) or a streaming .jsonl sink "
+                        "(export_stream)")
     t.add_argument("--json", action="store_true")
 
     args = p.parse_args(argv)
+    if args.serve is not None:
+        if args.cmd is not None:
+            p.error("--serve takes no subcommand")
+        if args.snapshot is None:
+            p.error("--serve needs --snapshot FILE (the document a "
+                    "soak keeps rewriting via run_soak snapshot_path=)")
+        snap_path = args.snapshot
+
+        def _read_snapshot() -> dict:
+            with open(snap_path) as fh:
+                return json.load(fh)
+
+        server = serve_status(args.serve, _read_snapshot,
+                              host=args.host)
+        host, port = server.server_address[:2]
+        print(f"serving {snap_path} on http://{host}:{port} "
+              "(/metrics for Prometheus, / for the snapshot JSON); "
+              "Ctrl-C to stop", file=sys.stderr)
+        try:
+            import threading
+
+            threading.Event().wait()  # the server threads do the work
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.shutdown()
+            server.server_close()
+        return 0
+    if args.cmd is None:
+        p.error("a subcommand (validate/prom/timeline) or --serve is "
+                "required")
     if args.cmd == "validate":
         with open(args.snapshot) as fh:
             doc = json.load(fh)

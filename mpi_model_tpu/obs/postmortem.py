@@ -121,12 +121,35 @@ def spans_from_chrome(path: str) -> list:
     return out
 
 
+def spans_from_jsonl(path: str) -> list:
+    """Span dicts out of an ``export_stream`` JSONL sink (ISSUE 20).
+    The file may end in a torn line (the writer was killed mid-append
+    — the sink's whole point is surviving exactly that); the torn
+    tail is skipped, everything before it loads."""
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue  # the torn tail of a killed writer
+            if isinstance(d, dict):
+                out.append(d)
+    return out
+
+
 def _span_dicts(spans) -> list:
-    """Normalize ``Tracer.spans`` (Span objects) / dict lists / a
-    chrome-trace path into plain span dicts."""
+    """Normalize ``Tracer.spans`` (Span objects) / dict lists / a span
+    FILE path — chrome trace, or an ``export_stream`` ``.jsonl``
+    stream — into plain span dicts."""
     if spans is None:
         return []
     if isinstance(spans, str):
+        if spans.endswith(".jsonl"):
+            return spans_from_jsonl(spans)
         return spans_from_chrome(spans)
     out = []
     for s in spans:

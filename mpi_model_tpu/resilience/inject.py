@@ -91,6 +91,26 @@ site           where the seam lives / what the fault does
                admission behave as if the residency budget were
                exhausted — the paging path (hibernate instead of
                shed) without needing real memory pressure
+``handshake``  the TCP accept-time HMAC challenge–response (ISSUE 20) —
+               ``kind="handshake_fail"`` makes one handshake leg send a
+               garbage digest, so the peer must close the connection
+               with a typed auth error BEFORE any frame is parsed
+               (``channel`` pins the member's ``service_id``)
+``wire``       ``kind="tcp_partition"`` (ISSUE 20) — one send/recv on
+               the targeted member's conn behaves as a network
+               partition: the conn closes and raises ``WireTimeout``,
+               exercising the jitter-tolerant deadline + fence path
+               without real packet loss
+``lease``      the supervisor lease (ISSUE 20) — ``kind=
+               "supervisor_kill"`` delivers the simulated ``kill -9``
+               to the ACTIVE supervisor at tick ``at``: it stops
+               renewing its lease and abandons serving (the loopback
+               hard-stop discipline), so the standby must take over
+               within the lease deadline and bump the journal epoch
+``journal``    ``kind="stale_epoch_append"`` (ISSUE 20) — one journal
+               append behaves as if issued by a ZOMBIE supervisor (its
+               handle epoch decremented below the fence), so the
+               epoch fence must reject it with ``StaleEpochError``
 =============  ==============================================================
 
 Zero overhead when disarmed: every seam starts with one module-global
@@ -127,6 +147,7 @@ __all__ = [
     "journal_torn",
     "hibernate_torn",
     "wake_corrupt",
+    "stale_epoch_append",
     "tear_file",
 ]
 
@@ -173,6 +194,11 @@ SITE_OF = {
     "hibernate_torn": "tiering",
     "wake_corrupt": "tiering",
     "residency_pressure": "tiering",
+    # ISSUE 20: multi-host fleet + supervisor failover seams
+    "handshake_fail": "handshake",
+    "tcp_partition": "wire",
+    "supervisor_kill": "lease",
+    "stale_epoch_append": "journal",
 }
 
 
@@ -230,7 +256,7 @@ class Fault:
         if self.tear not in ("truncate", "corrupt"):
             raise ValueError(f"unknown tear mode {self.tear!r}")
         if (self.kind in ("member_wedge", "heartbeat_loss", "proc_kill",
-                          "wire_torn")
+                          "wire_torn", "tcp_partition")
                 and not self.once and self.channel is None):
             # an unpinned sticky member/wire fault would re-fault every
             # replacement generation: fence → restart → fault, forever
@@ -575,6 +601,28 @@ def wake_corrupt(ticket) -> Optional["Fault"]:
             st._fire_locked(i, f)
             return f
         return None
+
+
+def stale_epoch_append(path: str) -> bool:
+    """Journal epoch-fence seam (ISSUE 20): True when a live
+    ``stale_epoch_append`` fault says THIS append should behave as a
+    zombie supervisor's — the epoch-fenced ``TicketJournal.append``
+    then checks the fence with its handle epoch decremented, so the
+    fence must reject the record with ``StaleEpochError`` (the
+    defense-in-depth the failover matrix asserts without needing a
+    real resurrected process)."""
+    st = _ACTIVE
+    if st is None:
+        return False
+    with st._mutex:
+        for i, f in enumerate(st.plan.faults):
+            if f.kind != "stale_epoch_append" or i in st._consumed:
+                continue
+            if f.channel is not None and f.channel != path:
+                continue
+            st._fire_locked(i, f)
+            return True
+        return False
 
 
 def tear_file(path: str, offset: int = 0, nbytes: int = 64,

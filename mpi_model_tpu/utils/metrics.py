@@ -299,7 +299,8 @@ class ThroughputCounter:
                 "member_faults", "readmitted", "scale_ups", "scale_downs",
                 "respawns", "heartbeats", "heartbeat_misses",
                 "wire_errors", "hibernations", "rehibernations",
-                "wakes", "wake_faults")
+                "wakes", "wake_faults", "supervisor_kills",
+                "stale_epoch_rejections")
 
     def __init__(self):
         # lockdep factory (ISSUE 12): plain Lock disarmed, witnessed
@@ -355,6 +356,12 @@ class ThroughputCounter:
         self.rehibernations = 0
         self.wakes = 0
         self.wake_faults = 0
+        #: ISSUE 20 (supervisor failover): injected supervisor kills
+        #: (the ``supervisor_kill`` chaos seam turning this supervisor
+        #: into a zombie) and journal appends the epoch fence refused
+        #: because a standby had already taken the stream over
+        self.supervisor_kills = 0
+        self.stale_epoch_rejections = 0
         #: the queue-latency and wake-latency reservoirs share ONE
         #: implementation (ISSUE 15 satellite): bounded, self-locked
         #: LatencyReservoir — wake latency is the wall seconds each
@@ -447,6 +454,8 @@ class ThroughputCounter:
                 "rehibernations": self.rehibernations,
                 "wakes": self.wakes,
                 "wake_faults": self.wake_faults,
+                "supervisor_kills": self.supervisor_kills,
+                "stale_epoch_rejections": self.stale_epoch_rejections,
                 **lat,
                 **wlat,
             }

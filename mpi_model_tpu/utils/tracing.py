@@ -23,6 +23,11 @@ speed"). Here tracing is structured and first-class:
   multi-process merges are labeled via ``process_name`` metadata
   records (``label_process`` — the fleet stamps members m<slot>g<gen>)
   and the export carries a top-level ``dropped`` count;
+- ``export_stream()`` (ISSUE 20) — a streaming JSONL sink: every span
+  appends to the file AS IT COMPLETES (bounded flush cadence), so a
+  killed supervisor's trace survives up to the kill instead of dying
+  with the never-written end-of-run export; ``obs.timeline`` accepts
+  the ``.jsonl`` file wherever it accepts a Chrome trace;
 - ``ingest()`` / ``spans_since()`` — the heartbeat shipping lane:
   a member exports its completed-span deltas as plain dicts
   (wall-clock-anchored, so merged timelines order across processes)
@@ -170,6 +175,11 @@ class Tracer:
         #: pid → human label for export_chrome's process_name metadata
         #: (the fleet labels members m<slot>g<gen> at heartbeat ingest)
         self._process_labels: dict[int, str] = {}
+        #: streaming JSONL sink (ISSUE 20): (open file, path, spans
+        #: written since the last flush) — see export_stream
+        self._stream = None
+        self._stream_path: Optional[str] = None
+        self._stream_pending = 0
 
     # -- trace context ------------------------------------------------------
 
@@ -260,6 +270,11 @@ class Tracer:
                  start_wall_s=t0 + self._wall_off, pid=self._pid)
         self._append(s)
 
+    #: flush the streaming sink every N spans — bounded data-at-risk
+    #: (a kill loses at most this many buffered spans) without paying
+    #: a syscall per span on the dispatch hot path
+    _STREAM_FLUSH_EVERY = 32
+
     def _append(self, s: Span) -> None:
         with self._lock:
             self._seq += 1
@@ -267,6 +282,41 @@ class Tracer:
             if len(self._spans) == self._spans.maxlen:
                 self.dropped += 1
             self._spans.append(s)
+            if self._stream is not None:
+                self._stream_write_locked(s)
+
+    def _stream_write_locked(self, s: Span) -> None:
+        try:
+            # default=repr: a span's meta may hold anything; the sink
+            # must never make recording a span raise at the call site.
+            # analysis: ignore[blocking-under-lock] — a buffered
+            # ~200-byte write into the libc FILE buffer (no syscall
+            # except at the bounded flush below); serializing it under
+            # the tracer lock is what keeps the JSONL lines whole when
+            # many threads complete spans at once
+            self._stream.write(
+                json.dumps(s.to_dict(), default=repr) + "\n")
+            self._stream_pending += 1
+            if self._stream_pending >= self._STREAM_FLUSH_EVERY:
+                # analysis: ignore[blocking-under-lock] — the bounded
+                # flush cadence: one syscall per _STREAM_FLUSH_EVERY
+                # spans, the documented data-at-risk/latency trade
+                self._stream.flush()
+                self._stream_pending = 0
+        except (OSError, ValueError) as e:
+            # a dead sink (full disk, closed fd) detaches — tracing
+            # continues into the ring; the loss is loud, once
+            import warnings
+
+            try:
+                self._stream.close()
+            except OSError:
+                pass
+            self._stream = None
+            self._stream_path = None
+            warnings.warn(
+                f"span stream sink failed and was detached: {e}",
+                RuntimeWarning)
 
     # -- cross-process shipping ---------------------------------------------
 
@@ -393,6 +443,58 @@ class Tracer:
             events.append({"name": "process_name", "ph": "M",
                            "pid": pid or 1, "args": {"name": name}})
         return events
+
+    def export_stream(self, path: str) -> str:
+        """Attach a streaming JSONL sink (ISSUE 20): every span
+        COMPLETED from now on appends to ``path`` as one JSON line
+        (the ``Span.to_dict`` projection) the moment it lands in the
+        ring — unlike ``export_chrome``, which writes nothing until
+        the run survives to its end. Flushes every
+        ``_STREAM_FLUSH_EVERY`` spans (bounded data-at-risk, no
+        syscall per span); ``close_stream()`` flushes the tail and
+        detaches. Append-mode: re-attaching after a takeover continues
+        the same file. A later ``export_stream`` replaces the sink."""
+        f = open(path, "a")
+        try:
+            # the previous writer may have been KILLED mid-line (the
+            # sink's whole point): appending straight after its torn
+            # tail would garble the first new span — start it on a
+            # fresh line instead (the reader skips the torn fragment)
+            with open(path, "rb") as rf:
+                rf.seek(0, os.SEEK_END)
+                if rf.tell() > 0:
+                    rf.seek(-1, os.SEEK_END)
+                    if rf.read(1) != b"\n":
+                        f.write("\n")
+        except OSError:  # pragma: no cover - best effort
+            pass
+        with self._lock:
+            old, self._stream = self._stream, f
+            self._stream_path = path
+            self._stream_pending = 0
+        if old is not None:
+            try:
+                old.flush()
+                old.close()
+            except OSError:  # pragma: no cover - best effort
+                pass
+        return path
+
+    def close_stream(self) -> Optional[str]:
+        """Flush + detach the streaming sink; returns its path (None
+        when no sink was attached). The ring keeps recording."""
+        with self._lock:
+            f, self._stream = self._stream, None
+            path, self._stream_path = self._stream_path, None
+            self._stream_pending = 0
+        if f is None:
+            return None
+        try:
+            f.flush()
+            f.close()
+        except OSError:  # pragma: no cover - best effort
+            pass
+        return path
 
     def export_chrome(self, path: str) -> str:
         """Write the trace as a ``chrome://tracing``/Perfetto JSON file.

@@ -499,6 +499,47 @@ def test_raw_transport_pragma_with_reason():
     assert rules_of(lint_source(src, PKG)) == []
 
 
+def test_raw_transport_auth_primitives_positive():
+    # ISSUE 20: the handshake's HMAC/secret primitives are part of the
+    # transport boundary — hand-rolling them elsewhere is a second,
+    # unaudited auth path beside the wire handshake
+    src = ("import hmac, secrets\n"
+           "def f(secret, nonce, digest):\n"
+           "    h = hmac.new(secret, nonce, 'sha256')\n"
+           "    hmac.compare_digest(h.hexdigest(), digest)\n"
+           "    return secrets.token_hex(32)\n")
+    assert rules_of(lint_source(src, PKG)) == ["raw-transport"] * 3
+    # the unambiguous from-import names fire bare too
+    src2 = ("from hmac import compare_digest\n"
+            "from secrets import token_bytes\n"
+            "def g(a, b):\n"
+            "    compare_digest(a, b)\n"
+            "    token_bytes(16)\n")
+    assert rules_of(lint_source(src2, PKG)) == ["raw-transport"] * 2
+
+
+def test_raw_transport_auth_allowed_at_the_wire_boundary():
+    src = ("import hmac, secrets\n"
+           "def f(secret, nonce):\n"
+           "    secrets.token_hex(32)\n"
+           "    return hmac.new(secret, nonce, 'sha256')\n")
+    for ok in ("mpi_model_tpu/ensemble/wire.py",
+               "mpi_model_tpu/ensemble/member_proc.py"):
+        assert rules_of(lint_source(src, ok)) == []
+    assert rules_of(lint_source(src, PKG)) == ["raw-transport"] * 2
+
+
+def test_raw_transport_auth_negative_generic_names():
+    # "new"/"digest" on non-hmac receivers, and hashlib's own digest
+    # calls, never fire — only the hmac/secrets modules are the tell
+    src = ("import hashlib\n"
+           "def f(factory, h):\n"
+           "    factory.new('x')\n"
+           "    hashlib.sha256(b'x').digest()\n"
+           "    h.digest()\n")
+    assert rules_of(lint_source(src, PKG)) == []
+
+
 # -- the repo gate ------------------------------------------------------------
 
 # -- naked-save (ISSUE 5: unverifiable-checkpoint guard) ----------------------
@@ -1199,6 +1240,25 @@ def test_journal_meta_drift_negative_declared_keys():
            "    rec.meta[\"t_wall\"]\n"
            "    return rec.meta.get(\"ticket\")\n")
     assert proto_rules_of(src) == []
+
+
+def test_journal_meta_drift_epoch_vocabulary():
+    # ISSUE 20: the EPOCH transition declares the failover vocabulary —
+    # writing an epoch record with its declared keys and reading the
+    # stamped epoch back are clean; a fork of the epoch meta is not
+    src = ("from mpi_model_tpu.ensemble.lifecycle import EPOCH\n"
+           "def takeover(rec, journal):\n"
+           "    journal.append(EPOCH, {\"epoch\": 2,\n"
+           "                           \"supervisor\": \"sup-b\",\n"
+           "                           \"takeover_from\": \"sup-a\",\n"
+           "                           \"lease_s\": 2.0}, None)\n"
+           "    return rec.meta.get(\"epoch\")\n")
+    assert proto_rules_of(src) == []
+    src2 = ("from mpi_model_tpu.ensemble.lifecycle import EPOCH\n"
+            "def takeover(journal):\n"
+            "    journal.append(EPOCH, {\"epoch\": 2,\n"
+            "                           \"fence_owner\": \"b\"}, None)\n")
+    assert proto_rules_of(src2) == ["journal-meta-drift"]
 
 
 def test_journal_meta_drift_pragma_escape():

@@ -1,0 +1,336 @@
+"""Supervisor failover + epoch fencing (ISSUE 20 tentpole).
+
+Tier-1 rows run the whole failover protocol on a fake clock with
+in-process members (zero subprocesses, zero sleeps): the ACTIVE named
+supervisor declares journal epoch 1 and renews ``supervisor.lease``
+per tick; a ``StandbySupervisor`` watches the lease, takes over when
+it goes stale (recover → epoch 2 → exactly-once re-admission), and the
+old supervisor — resurrected as a zombie — is fenced on BOTH planes:
+its journal appends raise ``StaleEpochError`` writing nothing, and its
+member RPCs come back typed ``err``. Chaos rows drive the same matrix
+through the ``supervisor_kill`` / ``stale_epoch_append`` seams. The
+REAL spawned-TCP failover soak is marked ``slow`` (the bench's
+failover leg runs the full version).
+"""
+
+import warnings
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mpi_model_tpu import CellularSpace, Diffusion, Model
+from mpi_model_tpu.ensemble import FleetSupervisor
+from mpi_model_tpu.ensemble.fleet import (StandbySupervisor, lease_path,
+                                          read_lease)
+from mpi_model_tpu.ensemble.journal import (StaleEpochError, TicketJournal,
+                                            audit_journal, current_epoch,
+                                            declare_epoch, journal_path,
+                                            replay)
+from mpi_model_tpu.ensemble.member_proc import spawn_loopback_member
+from mpi_model_tpu.ensemble.wire import RemoteError
+from mpi_model_tpu.resilience import inject
+from mpi_model_tpu.resilience.inject import Fault, FaultPlan
+
+
+def scen_space(i, g=16):
+    rng = np.random.default_rng((103, i, g))
+    v = jnp.asarray(rng.uniform(0.5, 2.0, (g, g)))
+    return CellularSpace.create(g, g, 1.0).with_values({"value": v})
+
+
+def scen_model(i=0):
+    return Model(Diffusion(0.05 + 0.01 * i), 4.0, 1.0)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def named_fleet(journal_dir, clock, sup="sup-a", **kw):
+    kw.setdefault("services", 1)
+    kw.setdefault("steps", 4)
+    return FleetSupervisor(scen_model(), start=False,
+                           journal_dir=str(journal_dir), clock=clock,
+                           supervisor_id=sup, lease_s=2.0, **kw)
+
+
+# -- lease + epoch declaration ------------------------------------------------
+
+def test_named_supervisor_declares_epoch_and_renews_lease(tmp_path):
+    clock = FakeClock()
+    fleet = named_fleet(tmp_path, clock)
+    assert fleet.journal.epoch == 1
+    assert current_epoch(journal_path(str(tmp_path))) == 1
+    rec = read_lease(lease_path(str(tmp_path)))
+    assert rec["owner"] == "sup-a" and rec["epoch"] == 1
+    assert rec["t"] == 0.0 and rec["lease_s"] == 2.0
+    clock.t = 1.5
+    fleet.tick()
+    assert read_lease(lease_path(str(tmp_path)))["t"] == 1.5
+    st = fleet.stats()
+    assert st["supervisor_id"] == "sup-a" and st["epoch"] == 1
+    assert st["supervisor_kills"] == 0
+    assert st["stale_epoch_rejections"] == 0
+    fleet.stop()
+    aud = audit_journal(journal_path(str(tmp_path)))
+    assert aud["ok"]
+    assert [e["epoch"] for e in aud["epochs"]] == [1]
+    assert aud["epochs"][0]["supervisor"] == "sup-a"
+    assert aud["epochs"][0]["takeover_from"] is None
+
+
+def test_supervisor_id_requires_journal_dir():
+    with pytest.raises(ValueError, match="journal_dir"):
+        FleetSupervisor(scen_model(), start=False,
+                        supervisor_id="sup-x")
+
+
+def test_anonymous_supervisor_keeps_unfenced_semantics(tmp_path):
+    # no supervisor_id: no epoch stamps, no lease file — PR-10 exactly
+    fleet = FleetSupervisor(scen_model(), start=False, services=1,
+                            steps=4, journal_dir=str(tmp_path))
+    assert fleet.journal.epoch is None
+    assert read_lease(lease_path(str(tmp_path))) is None
+    t = fleet.submit(scen_space(0))
+    fleet.pump_once()
+    fleet.result(t, timeout=5)
+    fleet.stop()
+    aud = audit_journal(journal_path(str(tmp_path)))
+    assert aud["ok"] and aud["epochs"] == []
+
+
+# -- standby takeover ---------------------------------------------------------
+
+def test_standby_holds_while_lease_is_fresh(tmp_path):
+    clock = FakeClock()
+    fleet = named_fleet(tmp_path, clock)
+    sb = StandbySupervisor(str(tmp_path), scen_model(),
+                           supervisor_id="sup-b", clock=clock,
+                           services=1, steps=4, start=False)
+    clock.t = 1.9  # age 1.9 < lease_s 2.0
+    assert not sb.should_takeover()
+    assert sb.poll() is None
+    clock.t = 1.0
+    fleet.tick()  # renewal resets the age
+    clock.t = 2.9
+    assert not sb.should_takeover()
+    fleet.stop()
+
+
+def test_standby_takeover_fences_zombie_and_serves_exactly_once(tmp_path):
+    """THE failover acceptance row, fake-clocked: the active dies with
+    one ticket unresolved; the standby takes over within the lease
+    bound, re-admits it under its ORIGINAL id, serves it exactly once
+    (replay audit), and the zombie's journal append + member RPC are
+    both refused."""
+    clock = FakeClock()
+    f1 = named_fleet(tmp_path, clock)
+    t_served = f1.submit(scen_space(0))
+    f1.pump_once()
+    space1, _ = f1.result(t_served, timeout=5)
+    t_pending = f1.submit(scen_space(1))  # journaled, never pumped
+    # the active "dies": no more ticks, the lease goes stale
+    sb = StandbySupervisor(str(tmp_path), scen_model(),
+                           supervisor_id="sup-b", clock=clock,
+                           services=1, steps=4, start=False)
+    clock.t = 2.5
+    assert sb.should_takeover()
+    f2 = sb.takeover()
+    assert sb.fleet is f2 and sb.poll() is None
+    assert f2.journal.epoch == 2
+    # the pending ticket came back under its original id
+    f2.pump_once()
+    space2, _ = f2.result(t_pending, timeout=5)
+    assert space2.values["value"].shape == (16, 16)
+    # zombie fencing, journal plane: the append writes NOTHING
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        f1._journal_append_locked("shed", {"ticket": 999})
+    assert f1.counter.snapshot()["stale_epoch_rejections"] == 1
+    with pytest.raises(StaleEpochError):
+        f1.journal.append("shed", {"ticket": 998})
+    f2.stop()
+    # exactly-once: one terminal per ticket, no stale records, and the
+    # epoch history names the handoff
+    aud = audit_journal(journal_path(str(tmp_path)))
+    assert aud["ok"]
+    assert aud["duplicate_terminals"] == []
+    assert aud["stale_epoch_records"] == []
+    assert [e["epoch"] for e in aud["epochs"]] == [1, 2]
+    assert aud["epochs"][1]["supervisor"] == "sup-b"
+    assert aud["epochs"][1]["takeover_from"] == "sup-a"
+    state = replay(journal_path(str(tmp_path)))
+    assert sorted(state.terminal) == sorted([t_served, t_pending])
+    assert state.unresolved() == []
+
+
+def test_standby_claims_leaseless_journal(tmp_path):
+    # a pre-lease (anonymous) supervisor crashed: journal exists, no
+    # lease file — the standby claims it rather than waiting forever
+    fleet = FleetSupervisor(scen_model(), start=False, services=1,
+                            steps=4, journal_dir=str(tmp_path))
+    t = fleet.submit(scen_space(0))
+    fleet.abandon()
+    sb = StandbySupervisor(str(tmp_path), scen_model(),
+                           supervisor_id="sup-b", services=1,
+                           steps=4, start=False)
+    assert sb.should_takeover()
+    f2 = sb.takeover()
+    f2.pump_once()
+    assert f2.result(t, timeout=5)
+    f2.stop()
+    assert audit_journal(journal_path(str(tmp_path)))["ok"]
+
+
+def test_standby_without_journal_waits(tmp_path):
+    sb = StandbySupervisor(str(tmp_path), scen_model(),
+                           supervisor_id="sup-b")
+    assert not sb.should_takeover()  # nothing to supervise yet
+
+
+# -- member-plane fencing -----------------------------------------------------
+
+def test_member_refuses_stale_epoch_rpc():
+    """The second fence plane: a member inherited by a newer
+    supervisor (higher epoch seen) answers a zombie's frames with a
+    typed err — the RPC raises RemoteError(StaleEpochError)."""
+    client = spawn_loopback_member(
+        scen_model(), service_id="m0g0",
+        member_kwargs=dict(steps=4, retry="solo"))
+    client.epoch = 2
+    assert client.heartbeat()  # ratchets the member to epoch 2
+    client.epoch = 1  # the zombie's stamp
+    with pytest.raises(RemoteError) as ei:
+        client.submit(scen_space(0))
+    assert ei.value.remote_type == "StaleEpochError"
+    client.epoch = 3  # a NEWER supervisor is always accepted
+    t = client.submit(scen_space(0))
+    while client.poll(t) is None:
+        client.pump_once(force=True)
+    client.close()
+
+
+def test_fleet_arms_member_epoch_on_spawn(tmp_path):
+    clock = FakeClock()
+    fleet = named_fleet(tmp_path, clock, member_transport="process",
+                        member_spawner=spawn_loopback_member,
+                        retry="solo")
+    svc = next(iter(fleet._members.values())).service
+    assert svc.epoch == 1
+    t = fleet.submit(scen_space(0))
+    fleet.pump_once()
+    assert fleet.result(t, timeout=5)
+    fleet.stop()
+
+
+# -- chaos seams --------------------------------------------------------------
+
+def test_supervisor_kill_seam_stops_supervision_dead(tmp_path):
+    clock = FakeClock()
+    fleet = named_fleet(tmp_path, clock, sup="sup-c")
+    plan = FaultPlan((Fault("supervisor_kill", channel="sup-c", at=2),))
+    with inject.armed(plan) as st:
+        fleet.tick()
+        assert not fleet._abandoned  # at=2: survives the first tick
+        fleet.tick()
+    assert [f["kind"] for f in st.fired] == ["supervisor_kill"]
+    assert fleet._abandoned and fleet._stopped
+    assert fleet.counter.snapshot()["supervisor_kills"] == 1
+    # the journal handle stays OPEN — the zombie shape the epoch
+    # fence exists for
+    assert fleet.journal is not None
+    # and a later tick is a no-op, like a killed process
+    fleet.tick()
+
+
+def test_supervisor_kill_then_standby_takeover_chaos_row(tmp_path):
+    clock = FakeClock()
+    f1 = named_fleet(tmp_path, clock, sup="sup-a")
+    t1 = f1.submit(scen_space(0))
+    with inject.armed(FaultPlan(
+            (Fault("supervisor_kill", channel="sup-a", at=1),))):
+        f1.tick()  # killed mid-soak, ticket unresolved
+    sb = StandbySupervisor(str(tmp_path), scen_model(),
+                           supervisor_id="sup-b", clock=clock,
+                           services=1, steps=4, start=False)
+    clock.t = 2.5  # past the dead active's lease
+    f2 = sb.poll()
+    assert f2 is not None
+    f2.pump_once()
+    assert f2.result(t1, timeout=5)
+    # the zombie's post-takeover append is fenced
+    with pytest.raises(StaleEpochError):
+        f1.journal.append("shed", {"ticket": 999})
+    f2.stop()
+    aud = audit_journal(journal_path(str(tmp_path)))
+    assert aud["ok"]
+    assert [e["epoch"] for e in aud["epochs"]] == [1, 2]
+
+
+def test_stale_epoch_append_seam_fences_a_current_handle(tmp_path):
+    clock = FakeClock()
+    fleet = named_fleet(tmp_path, clock)
+    jpath = journal_path(str(tmp_path))
+    plan = FaultPlan((Fault("stale_epoch_append", channel=jpath),))
+    with inject.armed(plan) as st:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            t = fleet.submit(scen_space(0))  # the submit append fences
+    assert [f["kind"] for f in st.fired] == ["stale_epoch_append"]
+    assert fleet.counter.snapshot()["stale_epoch_rejections"] == 1
+    # serving survived the fenced append; only the record is missing
+    fleet.pump_once()
+    assert fleet.result(t, timeout=5)
+    fleet.stop()
+    aud = audit_journal(jpath)
+    assert aud["ok"]  # the fence REFUSED the write — no stale record
+
+
+def test_stale_epoch_records_fail_the_audit(tmp_path):
+    """Defense-in-depth completeness: a record that somehow lands with
+    an older epoch stamp (fence file lost, handle raced) is flagged by
+    replay/audit — ok goes False and the indices are named."""
+    jpath = journal_path(str(tmp_path))
+    j1 = TicketJournal(jpath, epoch=0)
+    declare_epoch(j1, supervisor="sup-a")
+    j2 = TicketJournal(jpath, epoch=0)
+    declare_epoch(j2, supervisor="sup-b", takeover_from="sup-a")
+    # j1 is now stale; bypass its fence check by stamping meta directly
+    j2.append("shed", {"ticket": 1, "epoch": 1})
+    j2.close(), j1.close()
+    aud = audit_journal(jpath)
+    assert not aud["ok"]
+    assert aud["stale_epoch_records"], aud
+
+
+# -- the real thing (slow) ----------------------------------------------------
+
+@pytest.mark.slow
+def test_tcp_fleet_serves_through_authenticated_members(tmp_path):
+    """Real spawned children behind authenticated TCP: the fleet leg of
+    the wire handshake, end to end (the mid-soak kill -9 failover soak
+    lives in the bench's failover leg)."""
+    fleet = FleetSupervisor(scen_model(), services=2, steps=4,
+                            member_transport="tcp",
+                            journal_dir=str(tmp_path),
+                            supervisor_id="sup-tcp", start=True)
+    try:
+        assert fleet._heartbeat_deadline == 5.0
+        assert fleet._rpc_deadline == 60.0
+        tickets = [fleet.submit(scen_space(i)) for i in range(4)]
+        for t in tickets:
+            space, _ = fleet.result(t, timeout=60)
+            assert space.values["value"].shape == (16, 16)
+    finally:
+        fleet.stop()
+    aud = audit_journal(journal_path(str(tmp_path)))
+    assert aud["ok"]
+    assert [e["epoch"] for e in aud["epochs"]] == [1]
+    st = fleet.stats()
+    assert st["member_transport"] == "tcp"
+    assert st["wire_bytes_in"] > 0 and st["wire_bytes_out"] > 0

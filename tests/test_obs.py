@@ -540,8 +540,10 @@ def test_golden_verdicts_exactly_once(tmp_path):
     audit = audit_journal(path)
     audit.pop("path")
     assert audit == {
-        "duplicate_terminals": [], "kinds": {"served": 2, "submit": 2},
-        "ok": True, "records": 4, "shed": 0, "submits": 2,
+        "duplicate_terminals": [], "epochs": [],
+        "kinds": {"served": 2, "submit": 2},
+        "ok": True, "records": 4, "shed": 0,
+        "stale_epoch_records": [], "submits": 2,
         "terminal": 2, "torn": False, "unresolved": []}
     st = replay(path)
     assert (sorted(st.submits), sorted(st.terminal),
@@ -571,8 +573,10 @@ def test_golden_verdicts_torn_tail(tmp_path):
     audit = audit_journal(path)
     audit.pop("path")
     assert audit == {
-        "duplicate_terminals": [], "kinds": {"served": 1, "submit": 1},
-        "ok": True, "records": 2, "shed": 0, "submits": 1,
+        "duplicate_terminals": [], "epochs": [],
+        "kinds": {"served": 1, "submit": 1},
+        "ok": True, "records": 2, "shed": 0,
+        "stale_epoch_records": [], "submits": 1,
         "terminal": 1, "torn": True, "unresolved": []}
     st = replay(path)
     assert (sorted(st.submits), sorted(st.terminal), st.torn) == (
@@ -602,9 +606,10 @@ def test_golden_verdicts_duplicate_terminal(tmp_path):
     audit = audit_journal(path)
     audit.pop("path")
     assert audit == {
-        "duplicate_terminals": [0],
+        "duplicate_terminals": [0], "epochs": [],
         "kinds": {"quarantined": 1, "served": 1, "submit": 1},
-        "ok": False, "records": 3, "shed": 0, "submits": 1,
+        "ok": False, "records": 3, "shed": 0,
+        "stale_epoch_records": [], "submits": 1,
         "terminal": 1, "torn": False, "unresolved": []}
     assert replay(path).duplicate_terminals == [0]
     jd = str(tmp_path / "dup")

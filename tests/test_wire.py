@@ -6,6 +6,7 @@ hang, never partially apply. The socketpair here is the same transport
 the loopback fleet fake uses: real sockets, zero subprocesses."""
 
 import socket
+import threading
 import zlib
 
 import numpy as np
@@ -16,13 +17,18 @@ from mpi_model_tpu.ensemble.wire import (
     REPLY_KINDS,
     REQUEST_KINDS,
     FrameConn,
+    HandshakeError,
     RemoteError,
     WireClosed,
     WireError,
     WireTimeout,
+    client_handshake,
     encode_payload,
     frame,
     parse_payload,
+    serve_handshake,
+    tcp_dial,
+    tcp_listener,
 )
 from mpi_model_tpu.resilience import inject
 from mpi_model_tpu.resilience.inject import Fault, FaultPlan
@@ -305,3 +311,188 @@ def test_sticky_wire_faults_must_pin_their_member():
         Fault("heartbeat_loss", once=False)
     with pytest.raises(ValueError, match="must pin its"):
         Fault("proc_kill", once=False)
+    with pytest.raises(ValueError, match="must pin its"):
+        Fault("tcp_partition", once=False)
+
+
+# -- TCP transport + the HMAC handshake (ISSUE 20) ----------------------------
+# Subprocess-free by design: the handshake and the TW1 codec are
+# transport-agnostic byte streams, so every row below runs them over a
+# socketpair (the server half on a thread) — same walls as the unix
+# rows above, now behind authentication.
+
+HS_SECRET = "tw-test-secret"
+
+
+def _serve_on_thread(sock, secret=HS_SECRET, chaos_id=None):
+    """Run serve_handshake concurrently; returns (thread, errs) — a
+    failed server handshake lands in ``errs`` for the row to assert."""
+    errs: list = []
+
+    def run():
+        try:
+            serve_handshake(sock, secret, chaos_id=chaos_id)
+        except WireError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, errs
+
+
+def authed_pair():
+    """A mutually authenticated socketpair: (server sock, client sock),
+    handshake complete, ready for TW1 frames."""
+    a, b = socket.socketpair()
+    t, errs = _serve_on_thread(a)
+    client_handshake(b, HS_SECRET)
+    t.join(5.0)
+    assert not errs, errs
+    return a, b
+
+
+def test_handshake_then_bitwise_roundtrip_over_socketpair():
+    a, b = authed_pair()
+    c, s = FrameConn(b), FrameConn(a)
+    c.send("submit", {"ticket": 11}, SCENARIO_ARRAYS)
+    kind, meta, arrays = s.recv(deadline_s=5.0)
+    assert kind == "submit" and meta["ticket"] == 11
+    for k, v in SCENARIO_ARRAYS.items():
+        assert arrays[k].tobytes() == np.ascontiguousarray(
+            np.asarray(v)).tobytes()
+    c.close(), s.close()
+
+
+def test_tcp_listener_dial_handshake_roundtrip():
+    """The real-TCP leg: ephemeral listener, tcp_dial, mutual
+    handshake, one bitwise frame — the exact accept path
+    spawn_process_member runs, minus the subprocess."""
+    srv = tcp_listener()
+    host, port = srv.getsockname()[:2]
+    got = {}
+
+    def accept():
+        sock, _ = srv.accept()
+        serve_handshake(sock, HS_SECRET)
+        got["sock"] = sock
+
+    t = threading.Thread(target=accept, daemon=True)
+    t.start()
+    cs = tcp_dial(f"{host}:{port}")
+    client_handshake(cs, HS_SECRET)
+    t.join(5.0)
+    c, s = FrameConn(cs), FrameConn(got["sock"])
+    c.send("poll", {"ticket": 5}, SCENARIO_ARRAYS)
+    kind, meta, arrays = s.recv(deadline_s=5.0)
+    assert kind == "poll" and meta["ticket"] == 5
+    for k, v in SCENARIO_ARRAYS.items():
+        assert arrays[k].tobytes() == np.ascontiguousarray(
+            np.asarray(v)).tobytes()
+    c.close(), s.close(), srv.close()
+
+
+def test_tcp_dial_unreachable_is_typed():
+    srv = tcp_listener()
+    host, port = srv.getsockname()[:2]
+    srv.close()  # nobody listens there anymore
+    with pytest.raises(WireClosed):
+        tcp_dial(f"{host}:{port}", deadline_s=2.0)
+    with pytest.raises(ValueError, match="host:port"):
+        tcp_dial("no-port-here")
+
+
+def test_handshake_wrong_secret_refused_both_sides():
+    a, b = socket.socketpair()
+    t, errs = _serve_on_thread(a, secret=HS_SECRET)
+    with pytest.raises(HandshakeError):
+        client_handshake(b, "the-wrong-secret")
+    t.join(5.0)
+    assert len(errs) == 1 and isinstance(errs[0], HandshakeError)
+    assert "wrong wire secret" in str(errs[0])
+
+
+def test_handshake_truncated_challenge_is_typed():
+    a, b = socket.socketpair()
+    a.sendall(b"TWA1 abc")  # a listener that died mid-challenge
+    a.close()
+    with pytest.raises(HandshakeError):
+        client_handshake(b, HS_SECRET)
+
+
+def test_handshake_garbled_magic_is_typed():
+    a, b = socket.socketpair()
+    a.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: 999999\r\n\r\n")
+    with pytest.raises(HandshakeError):
+        client_handshake(b, HS_SECRET)
+    a.close()
+
+
+def test_handshake_slow_peer_hits_the_deadline():
+    a, b = socket.socketpair()  # the listener never sends a challenge
+    with pytest.raises(HandshakeError):
+        client_handshake(b, HS_SECRET, deadline_s=0.2)
+    a.close()
+
+
+def test_handshake_fail_chaos_seam_garbles_the_proof():
+    a, b = socket.socketpair()
+    plan = FaultPlan((Fault("handshake_fail", channel="m7g0"),))
+    with inject.armed(plan) as st:
+        t, errs = _serve_on_thread(a)
+        with pytest.raises(HandshakeError):
+            client_handshake(b, HS_SECRET, chaos_id="m7g0")
+        t.join(5.0)
+    assert [f["kind"] for f in st.fired] == ["handshake_fail"]
+    assert len(errs) == 1 and "wrong wire secret" in str(errs[0])
+
+
+def test_tcp_torn_at_every_boundary_after_handshake():
+    """The unix torn wall, rebuilt behind authentication: an
+    authenticated peer that dies after ANY prefix of a frame still
+    surfaces as a typed wire error, never a hang or a partial frame."""
+    data = _small_frame()
+    for i in range(len(data)):
+        a, b = authed_pair()
+        a.sendall(data[:i])
+        a.close()
+        s = FrameConn(b)
+        with pytest.raises(WireError):
+            s.recv(deadline_s=5.0)
+        s.close()
+
+
+def test_tcp_bit_flip_at_every_position_after_handshake():
+    data = _small_frame()
+    for i in range(len(data)):
+        flipped = data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+        a, b = authed_pair()
+        a.sendall(flipped)
+        a.close()
+        s = FrameConn(b)
+        with pytest.raises(WireError):
+            s.recv(deadline_s=5.0)
+        s.close()
+
+
+def test_tcp_partition_seam_closes_and_times_out_on_send():
+    c, s = conn_pair()
+    c.chaos_id = "m0g0"
+    plan = FaultPlan((Fault("tcp_partition", channel="m0g0"),))
+    with inject.armed(plan) as st:
+        with pytest.raises(WireTimeout):
+            c.send("poll", {"ticket": 1})
+    assert [f["kind"] for f in st.fired] == ["tcp_partition"]
+    assert c.closed
+    s.close()
+
+
+def test_tcp_partition_seam_fires_on_recv_too():
+    c, s = conn_pair()
+    s.chaos_id = "m1g0"
+    plan = FaultPlan((Fault("tcp_partition", channel="m1g0"),))
+    with inject.armed(plan) as st:
+        c.send("poll", {"ticket": 1})
+        with pytest.raises(WireTimeout):
+            s.recv(deadline_s=5.0)
+    assert [f["kind"] for f in st.fired] == ["tcp_partition"]
+    c.close()
